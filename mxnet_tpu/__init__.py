@@ -1,0 +1,49 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the MXNet API.
+
+Brand-new implementation targeting JAX/XLA/Pallas/pjit on TPU, with the
+capability surface of Apache MXNet 1.6 (reference repo: eric-haibin-lin/mxnet).
+See SURVEY.md for the component map this implements.
+
+Usage mirrors MXNet:
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from .base import MXNetError, __version__, register_op, list_ops
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus,
+                      gpu_memory_info, current_context)
+from . import ops        # registers all operators
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .random import seed
+from . import initializer
+from .initializer import init  # noqa: F401
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from .kvstore import create as _kv_create  # noqa: F401
+from . import io
+from . import recordio
+from . import gluon
+from . import profiler
+from . import callback
+from . import runtime
+from . import engine
+from . import util
+from . import test_utils
+from . import numpy as np  # numpy-compatible frontend (mx.np)
+from . import numpy_extension as npx
+from . import symbol
+from . import symbol as sym
+from . import module
+from . import visualization as viz
+from . import parallel
+
+__all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
+           'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError']

@@ -1,0 +1,242 @@
+"""Imperative runtime: eager op dispatch + autograd tape.
+
+TPU-native analog of src/imperative/imperative.cc. The reference pushes each
+op into a C++ dependency engine; here, jax's async dispatch IS the engine —
+every op call returns immediately with a future-backed jax.Array, ordering is
+data-flow, and `wait_to_read` == `block_until_ready` (ref:
+include/mxnet/ndarray.h:368). Autograd is a Python tape of `jax.vjp`
+closures (ref: Imperative::RecordOp, include/mxnet/imperative.h:140).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax
+
+from .base import state
+
+
+class TapeNode:
+    __slots__ = ("inputs", "outputs", "vjp_fn", "fn", "name")
+
+    def __init__(self, inputs, outputs, vjp_fn, fn=None, name=""):
+        self.inputs = inputs      # list of NDArray
+        self.outputs = outputs    # list of NDArray
+        self.vjp_fn = vjp_fn      # cotangent(s) -> input cotangents
+        self.fn = fn              # pure fn over jax arrays (for create_graph)
+        self.name = name
+
+
+class _Tape(threading.local):
+    def __init__(self):
+        self.nodes: List[TapeNode] = []
+
+    def clear(self):
+        self.nodes = []
+
+
+tape = _Tape()
+
+
+def invoke(fn: Callable, args: tuple, kwargs: dict):
+    """Dispatch `fn` (a pure function over jax arrays) on NDArray arguments.
+
+    Returns (raw jax output(s), tensor inputs, vjp_fn-or-None, pure_fn).
+    """
+    from .ndarray.ndarray import NDArray
+
+    tensor_inputs: List[Any] = []
+    spec_args = []
+    for a in args:
+        if isinstance(a, NDArray):
+            spec_args.append(len(tensor_inputs))
+            tensor_inputs.append(a)
+        else:
+            spec_args.append((a,))
+    spec_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray):
+            spec_kwargs[k] = len(tensor_inputs)
+            tensor_inputs.append(v)
+        else:
+            spec_kwargs[k] = (v,)
+
+    def g(*datas):
+        call_args = [datas[s] if isinstance(s, int) else s[0] for s in spec_args]
+        call_kwargs = {k: (datas[s] if isinstance(s, int) else s[0])
+                       for k, s in spec_kwargs.items()}
+        return fn(*call_args, **call_kwargs)
+
+    datas = tuple(t._data for t in tensor_inputs)
+    recording = state.is_recording and any(t._in_graph for t in tensor_inputs)
+
+    if not recording:
+        return g(*datas), tensor_inputs, None, g
+
+    out_data, vjp_fn = jax.vjp(g, *datas)
+    return out_data, tensor_inputs, vjp_fn, g
+
+
+def record_node(tensor_inputs, outputs, vjp_fn, fn=None, name=""):
+    node = TapeNode(list(tensor_inputs), list(outputs), vjp_fn, fn, name)
+    for o in outputs:
+        o._in_graph = True
+    tape.nodes.append(node)
+    return node
+
+
+def _is_float0(x):
+    return getattr(x, 'dtype', None) is not None and str(x.dtype) == 'float0'
+
+
+def _accumulate(grad_map, heads, head_grads, nodes, create_graph):
+    """Reverse sweep over `nodes`, filling grad_map (id(ndarray) -> NDArray)."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray, _wrap
+
+    for node in reversed(nodes):
+        cts = []
+        touched = False
+        for o in node.outputs:
+            ct = grad_map.get(id(o))
+            if ct is None:
+                cts.append(None)
+            else:
+                touched = True
+                cts.append(ct)
+        if not touched or node.vjp_fn is None:
+            continue
+        ct_arrs = [c if c is not None else _wrap(jnp.zeros_like(o._data))
+                   for c, o in zip(cts, node.outputs)]
+
+        if create_graph and node.fn is not None:
+            n_in = len(node.inputs)
+            node_fn = node.fn
+
+            def bwd(*datas, _n_in=n_in, _fn=node_fn):
+                in_datas = datas[:_n_in]
+                ct_datas = datas[_n_in:]
+                _, vjp2 = jax.vjp(_fn, *in_datas)
+                ct_s = ct_datas[0] if len(ct_datas) == 1 else tuple(ct_datas)
+                return vjp2(ct_s)
+
+            out_data, t_inputs, vjp_fn2, gfn = invoke(
+                bwd, tuple(node.inputs) + tuple(ct_arrs), {})
+            in_ct_arrs = [None if _is_float0(d) else _wrap(d) for d in out_data]
+            if vjp_fn2 is not None:
+                rec_outs = [a if a is not None else _wrap(d)
+                            for a, d in zip(in_ct_arrs, out_data)]
+                record_node(t_inputs, rec_outs, vjp_fn2, gfn,
+                            "grad_" + node.name)
+        else:
+            ct_struct = (ct_arrs[0]._data if len(node.outputs) == 1
+                         else tuple(c._data for c in ct_arrs))
+            in_cts = node.vjp_fn(ct_struct)
+            in_ct_arrs = [None if _is_float0(d) else _wrap(d) for d in in_cts]
+
+        for inp, ict in zip(node.inputs, in_ct_arrs):
+            if ict is None:
+                continue
+            prev = grad_map.get(id(inp))
+            if prev is None:
+                grad_map[id(inp)] = ict
+            else:
+                grad_map[id(inp)] = prev + ict
+
+
+def _seed_heads(heads, head_grads):
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray, _wrap
+    grad_map = {}
+    for h, hg in zip(heads, head_grads):
+        if isinstance(hg, NDArray):
+            g = hg
+        elif hg is None:
+            g = _wrap(jnp.ones_like(h._data))
+        else:
+            g = _wrap(hg)
+        prev = grad_map.get(id(h))
+        grad_map[id(h)] = g if prev is None else prev + g
+    return grad_map
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse pass writing into leaf `.grad` arrays (ref:
+    Imperative::Backward, src/imperative/imperative.cc:280)."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    nodes = list(tape.nodes)
+    grad_map = _seed_heads(heads, head_grads)
+    rec = state.is_recording
+    state.is_recording = False
+    try:
+        _accumulate(grad_map, heads, head_grads, nodes, create_graph=False)
+    finally:
+        state.is_recording = rec
+
+    seen = set()
+    for node in nodes:
+        for arr in node.inputs + node.outputs:
+            if id(arr) in seen:
+                continue
+            seen.add(id(arr))
+            if arr._grad is not None and id(arr) in grad_map:
+                _write_grad(arr, grad_map[id(arr)])
+    for h in heads:
+        if id(h) not in seen and h._grad is not None and id(h) in grad_map:
+            _write_grad(h, grad_map[id(h)])
+
+    if not retain_graph:
+        tape.clear()
+
+
+def _write_grad(arr, g):
+    if arr._grad_req == 'add':
+        arr._grad._data = arr._grad._data + g._data.astype(arr._grad._data.dtype)
+    elif arr._grad_req != 'null':
+        arr._grad._data = g._data.astype(arr._grad._data.dtype)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """autograd.grad (ref: python/mxnet/autograd.py:271); supports
+    higher-order gradients via create_graph=True."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import _wrap
+
+    single = not isinstance(variables, (list, tuple))
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if single:
+        variables = [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    nodes = list(tape.nodes)
+    grad_map = _seed_heads(heads, head_grads)
+
+    rec = state.is_recording
+    if not create_graph:
+        state.is_recording = False
+    try:
+        _accumulate(grad_map, heads, head_grads, nodes, create_graph)
+    finally:
+        state.is_recording = rec
+
+    results = []
+    for v in variables:
+        g = grad_map.get(id(v))
+        if g is None:
+            g = _wrap(jnp.zeros_like(v._data))
+        results.append(g)
+    if not retain_graph:
+        tape.clear()
+    return results[0] if single else results

@@ -1,0 +1,128 @@
+"""Autograd: record/pause/train_mode/predict_mode + backward/grad.
+
+Ref: python/mxnet/autograd.py:120-179,244,271,368. Semantics preserved; the
+machinery is the jax.vjp tape in mxnet_tpu._imperative.
+"""
+from __future__ import annotations
+
+from .base import state
+from . import _imperative
+from ._imperative import grad  # noqa: F401  (public API)
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = state.is_recording
+            state.is_recording = self._enter_is_record
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = state.is_training
+            state.is_training = self._enter_train_mode
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            state.is_recording = self._prev_is_record
+        if self._enter_train_mode is not None:
+            state.is_training = self._prev_train_mode
+
+
+def record(train_mode=True):
+    """Scope for recording the autograd graph (ref: autograd.py:120)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording():
+    return state.is_recording
+
+
+def is_training():
+    return state.is_training
+
+
+def set_recording(is_record):
+    prev = state.is_recording
+    state.is_recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_flag):
+    prev = state.is_training
+    state.is_training = bool(train_mode_flag)
+    return prev
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    """Ref: autograd.py mark_variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._in_graph = True
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Ref: autograd.py:244."""
+    _imperative.backward(heads, head_grads, retain_graph, train_mode)
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "get_symbol: use HybridBlock.export / symbol tracing instead")
+
+
+class Function:
+    """Custom differentiable function (ref: autograd.py:368).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads); call the instance on NDArrays.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+        import jax.numpy as jnp
+
+        datas = tuple(x._data for x in inputs)
+        outs = self.forward(*[_wrap(d) for d in datas])
+        single = not isinstance(outs, (list, tuple))
+        out_list = [outs] if single else list(outs)
+
+        if state.is_recording and any(x._in_graph for x in inputs):
+            fwd_self = self
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                gs = fwd_self.backward(*[_wrap(c) for c in cts])
+                if not isinstance(gs, (list, tuple)):
+                    gs = [gs]
+                return tuple(g._data for g in gs)
+
+            _imperative.record_node(list(inputs), out_list, vjp_fn, None,
+                                    type(self).__name__)
+        return out_list[0] if single else tuple(out_list)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

@@ -1,0 +1,112 @@
+"""Core shared infrastructure: errors, op registry, version.
+
+TPU-native re-imagination of the reference's base layer
+(ref: include/mxnet/base.h, python/mxnet/base.py). Instead of a C API +
+ctypes bridge, ops are plain Python callables over jax.Arrays registered in
+an in-process registry (the analog of NNVM_REGISTER_OP,
+ref: include/mxnet/op_attr_types.h:218-347).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__version__ = "2.0.0.tpu"
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by the framework (ref: python/mxnet/base.py MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# Operator registry.
+#
+# The reference registers 533 ops via NNVM with attribute functions
+# (FCompute, FInferShape, FGradient...). On TPU the compute function IS the
+# lowering rule: a pure function over jax arrays that XLA traces and fuses.
+# Shape/dtype inference comes for free from jax's abstract evaluation, so the
+# registry only carries the compute fn plus optional metadata.
+# ---------------------------------------------------------------------------
+
+class OpDef:
+    __slots__ = ("name", "fn", "num_outputs", "mutate_inputs", "nograd", "doc")
+
+    def __init__(self, name: str, fn: Callable, num_outputs: int = 1,
+                 mutate_inputs: tuple = (), nograd: bool = False, doc: str = ""):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.mutate_inputs = mutate_inputs
+        self.nograd = nograd
+        self.doc = doc or (fn.__doc__ or "")
+
+
+_OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: Optional[str] = None, num_outputs: int = 1,
+                mutate_inputs: tuple = (), nograd: bool = False):
+    """Register a pure jax-level compute function as a framework op."""
+    def deco(fn: Callable):
+        opname = name or fn.__name__
+        _OP_REGISTRY[opname] = OpDef(opname, fn, num_outputs, mutate_inputs, nograd)
+        return fn
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"Operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Generic string-keyed object registries (ref: python/mxnet/registry.py) used
+# by optimizers, initializers, metrics, datasets...
+# ---------------------------------------------------------------------------
+
+class Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._store: Dict[str, Any] = {}
+
+    def register(self, obj: Any = None, name: Optional[str] = None):
+        def deco(o):
+            key = (name or o.__name__).lower()
+            self._store[key] = o
+            return o
+        if obj is None:
+            return deco
+        return deco(obj)
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._store:
+            raise MXNetError(f"Unknown {self.kind} {name!r}. "
+                             f"Registered: {sorted(self._store)}")
+        return self._store[key]
+
+    def create(self, name, *args, **kwargs):
+        if isinstance(name, str):
+            return self.get(name)(*args, **kwargs)
+        return name
+
+    def list(self):
+        return sorted(self._store)
+
+
+class _ThreadLocalState(threading.local):
+    """Thread-local runtime flags (ref: include/mxnet/imperative.h:206-212)."""
+
+    def __init__(self):
+        self.is_recording = False
+        self.is_training = False
+        self.is_deferred_compute = False
+
+
+state = _ThreadLocalState()

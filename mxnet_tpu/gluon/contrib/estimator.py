@@ -1,0 +1,394 @@
+"""Estimator fit loop + event handlers.
+
+Ref: python/mxnet/gluon/contrib/estimator/{estimator.py,event_handler.py}.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import metric as metric_mod
+from ...base import MXNetError
+from ...context import cpu, num_gpus, gpu
+from .. import Trainer
+from ..loss import Loss as BaseLoss
+from ...ndarray.utils import split_and_load
+from ... import autograd
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch/max_batch (ref: event_handler.py StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = estimator.max_epoch
+        self.max_batch = estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.current_batch == self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch == self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    def __init__(self, train_metrics):
+        self.train_metrics = train_metrics or []
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.train_metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs['pred']
+        label = kwargs['label']
+        loss = kwargs['loss']
+        for m in self.train_metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Ref: event_handler.py LoggingHandler."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval='epoch', metrics=None, priority=-10000):
+        self.metrics = metrics or []
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.log_interval = log_interval
+        self.priority = priority
+        self.logger = logging.getLogger('estimator')
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = f'Train finished using total {train_time:.2f}s at epoch {self.current_epoch}. '
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f'{name}: {value:.4f}, '
+        self.logger.info(msg.rstrip(', '))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval == 'batch' or self.log_interval == self.LOG_PER_BATCH:
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.log_interval == 'batch' or self.log_interval == self.LOG_PER_BATCH:
+            batch_time = time.time() - self.batch_start
+            msg = f'[Epoch {self.current_epoch}][Batch {self.batch_index}]'
+            cur_batches = kwargs.get('batch')
+            if cur_batches is not None:
+                self.processed_samples += cur_batches.data[0].shape[0] \
+                    if hasattr(cur_batches, 'data') else 0
+            msg += f' time/batch: {batch_time:.3f}s '
+            for m in self.metrics:
+                name, value = m.get()
+                msg += f'{name}: {value:.4f}, '
+            self.logger.info(msg.rstrip(', '))
+        self.batch_index += 1
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch_time = time.time() - self.epoch_start
+        msg = f'[Epoch {self.current_epoch}] finished in {epoch_time:.3f}s: '
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f'{name}: {value:.4f}, '
+        self.logger.info(msg.rstrip(', '))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Ref: event_handler.py CheckpointHandler."""
+
+    def __init__(self, model_dir, model_prefix='model', monitor=None,
+                 verbose=0, save_best=False, mode='auto', epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator)
+
+    def _save(self, estimator):
+        import os
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(
+            f'{prefix}-epoch{self.current_epoch}.params')
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                f'{prefix}-epoch{self.current_epoch}.states')
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Ref: event_handler.py EarlyStoppingHandler."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode='auto',
+                 baseline=None):
+        import numpy as onp
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode == 'min' or (mode == 'auto' and 'acc' not in monitor.get()[0]):
+            self.monitor_op = onp.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = onp.greater
+
+    def train_begin(self, estimator, *args, **kwargs):
+        import numpy as onp
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        monitor_name, monitor_value = self.monitor.get()
+        if monitor_value is None or monitor_value != monitor_value:
+            self.current_epoch += 1
+            return
+        if self.monitor_op(monitor_value - self.min_delta, self.best):
+            self.best = monitor_value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            logging.getLogger('estimator').info(
+                'Epoch %d: early stopping', self.stopped_epoch)
+
+
+class Estimator:
+    """Training loop driver (ref: estimator.py Estimator)."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.loss = loss if isinstance(loss, (list, tuple)) else [loss]
+        self.train_metrics = metrics if isinstance(metrics, list) else \
+            ([metrics] if metrics else [metric_mod.Accuracy()])
+        self.context = context or self._check_context()
+        self._initialize(initializer)
+        self.trainer = trainer or Trainer(
+            self.net.collect_params(), 'sgd', {'learning_rate': 0.001})
+        self.stop_training = False
+        self.max_epoch = None
+        self.max_batch = None
+
+    def _check_context(self):
+        if num_gpus() > 0:
+            return [gpu(0)]
+        return [cpu()]
+
+    def _initialize(self, initializer):
+        params = self.net.collect_params()
+        uninit = any(p._data is None and not p._deferred_init
+                     for p in params.values())
+        try:
+            self.net.initialize(init=initializer, ctx=self.context)
+        except Exception:
+            pass
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        val_metrics = val_metrics or self.train_metrics
+        for m in val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._get_data_and_label(batch, self.context,
+                                                   batch_axis)
+            pred = [self.net(x) for x in data]
+            for m in val_metrics:
+                if isinstance(m, metric_mod.Loss):
+                    losses = [self.loss[0](yhat, y)
+                              for yhat, y in zip(pred, label)]
+                    m.update(0, losses)
+                else:
+                    m.update(label, pred)
+        return val_metrics
+
+    def _get_data_and_label(self, batch, ctx, batch_axis=0):
+        if hasattr(batch, 'data'):
+            data, label = batch.data[0], batch.label[0]
+        else:
+            data, label = batch
+        data = split_and_load(data, ctx, batch_axis=batch_axis)
+        label = split_and_load(label, ctx, batch_axis=batch_axis)
+        return data, label
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        """Ref: estimator.py fit."""
+        self.max_epoch = epochs
+        self.max_batch = batches
+        if not self.max_epoch and not self.max_batch:
+            raise MXNetError("Either epochs or batches must be specified")
+        event_handlers = self._prepare_default_handlers(val_data,
+                                                        event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+        self.stop_training = False
+        for handler in train_begin:
+            handler.train_begin(self)
+        while not self.stop_training:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                data, label = self._get_data_and_label(batch, self.context,
+                                                       batch_axis)
+                batch_size = data[0].shape[batch_axis] * len(data)
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = [self.net(x) for x in data]
+                    losses = [self.loss[0](yhat, y)
+                              for yhat, y in zip(pred, label)]
+                for l in losses:
+                    l.backward()
+                self.trainer.step(batch_size)
+                for handler in batch_end:
+                    handler.batch_end(self, batch=batch, pred=pred,
+                                      label=label, loss=losses)
+                if self.stop_training:
+                    break
+            for handler in epoch_end:
+                handler.epoch_end(self)
+        for handler in train_end:
+            handler.train_end(self)
+
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = list(event_handlers or [])
+        added_default = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            event_handlers.append(StoppingHandler(self.max_epoch,
+                                                  self.max_batch))
+            added_default.append('StoppingHandler')
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            event_handlers.append(MetricHandler(self.train_metrics))
+            added_default.append('MetricHandler')
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            event_handlers.append(LoggingHandler(metrics=self.train_metrics))
+            added_default.append('LoggingHandler')
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in event_handlers):
+            event_handlers.append(ValidationHandler(val_data, self.evaluate))
+        return event_handlers
+
+    def _categorize_handlers(self, event_handlers):
+        train_begin = [h for h in event_handlers if isinstance(h, TrainBegin)]
+        epoch_begin = [h for h in event_handlers if isinstance(h, EpochBegin)]
+        batch_begin = [h for h in event_handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in event_handlers if isinstance(h, BatchEnd)]
+        epoch_end = [h for h in event_handlers if isinstance(h, EpochEnd)]
+        train_end = [h for h in event_handlers if isinstance(h, TrainEnd)]
+        return (train_begin, epoch_begin, batch_begin, batch_end, epoch_end,
+                train_end)

@@ -1,0 +1,61 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock, Block
+from .. import nn as _nn
+
+
+class HybridConcurrent(HybridBlock):
+    """Apply children to same input, concat outputs (ref: contrib
+    HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        from ... import ndarray as F
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.identity(x)
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse grad semantics; dense gather on TPU
+    (ref: contrib SparseEmbedding — see SURVEY §7(e))."""
+
+    def __init__(self, input_dim, output_dim, dtype='float32',
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get(
+            'weight', shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, grad_stype='row_sparse')
+
+    def forward(self, x):
+        from ... import ndarray as F
+        return F.embedding(x, self.weight.data(x.context),
+                           input_dim=self._input_dim,
+                           output_dim=self._output_dim, sparse_grad=True)
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, block_size=self._factor)

@@ -1,0 +1,6 @@
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      FilterSampler, BatchSampler, IntervalSampler)
+from .dataloader import DataLoader
+from . import vision

@@ -1,0 +1,97 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference uses multiprocessing workers with shared-memory NDArray
+pickling (dataloader.py:121-186). Host decode on TPU VMs is plentiful, and
+jax arrays don't share across fork, so num_workers maps to a thread pool —
+decode/augment release the GIL in PIL/numpy, and batches are device_put
+asynchronously, matching the prefetch-overlap behavior.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return array(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return array(data)
+
+
+def default_mp_batchify_fn(data):
+    return default_batchify_fn(data)
+
+
+class DataLoader:
+    """Ref: dataloader.py DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or 'keep')
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            batchify_fn = default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            def fetch(batch):
+                return self._batchify_fn([self._dataset[idx] for idx in batch])
+
+            batches = list(self._batch_sampler)
+            depth = max(1, self._prefetch)
+            futures = []
+            it = iter(batches)
+            for _ in range(depth):
+                try:
+                    futures.append(pool.submit(fetch, next(it)))
+                except StopIteration:
+                    break
+            while futures:
+                f = futures.pop(0)
+                try:
+                    futures.append(pool.submit(fetch, next(it)))
+                except StopIteration:
+                    pass
+                yield f.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,401 @@
+"""Parameter / ParameterDict (ref: python/mxnet/gluon/parameter.py).
+
+Deferred init (shape resolved at first forward, ref: parameter.py:114-116,
+229-234) is preserved. Parameters keep per-context NDArray copies like the
+reference (the copies are how single-process multi-device DP tests work);
+on a TPU pod the compiled training path instead shards one copy over the
+mesh (mxnet_tpu.parallel) — both views are supported.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, array, zeros as nd_zeros
+from .. import initializer as init_mod
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    """A Block parameter (ref: gluon/parameter.py Parameter)."""
+
+    def __init__(self, name, grad_req='write', shape=None, dtype='float32',
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype='default', grad_stype='default'):
+        self.name = name
+        self._grad_req = grad_req if differentiable else 'null'
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None          # list of per-ctx NDArray
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self._trace_tls = threading.local()
+
+    # --- trace override: CachedOp substitutes tracer-backed proxies --------
+    def _set_trace_proxy(self, arr):
+        self._trace_tls.proxy = arr
+
+    def _clear_trace_proxy(self):
+        self._trace_tls.proxy = None
+
+    @property
+    def _trace_proxy(self):
+        return getattr(self._trace_tls, 'proxy', None)
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ('write', 'add', 'null')
+        self._grad_req = req
+        if req == 'null':
+            self._grad = None
+        elif self._data is not None and self._grad is None:
+            self._init_grad()
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def _shape_complete(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Ref: parameter.py initialize."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not self._shape_complete():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self.shape}.")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init or self.init or default_init
+        host = nd_zeros(self.shape, dtype=self.dtype)
+        init_mod.create(initializer)(
+            init_mod.InitDesc(self.name, {'__init_name__': self.name}), host)
+        self._data = [host.as_in_context(c) if c != cpu(0) else
+                      NDArray(host._data, c) for c in ctx]
+        self._ctx_list = list(ctx)
+        self._deferred_init = ()
+        if self._grad_req != 'null':
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = []
+        for d in self._data:
+            d.attach_grad(self._grad_req)
+            self._grad.append(d.grad)
+
+    def _finish_deferred_init(self, shape=None):
+        if shape is not None:
+            new_shape = tuple(shape)
+            if self.shape is not None:
+                merged = []
+                for old, new in zip(self.shape, new_shape):
+                    if old > 0 and new > 0 and old != new:
+                        raise MXNetError(
+                            f"deferred shape mismatch for {self.name}: "
+                            f"{self.shape} vs {new_shape}")
+                    merged.append(old if old > 0 else new)
+                self.shape = tuple(merged)
+            else:
+                self.shape = new_shape
+        if not self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter '{self.name}' has not been initialized yet "
+                    "because initialization was deferred. Call net(data) once "
+                    "or initialize with a complete shape.")
+            raise MXNetError(
+                f"Parameter '{self.name}' has not been initialized. You "
+                "should initialize parameters and create Trainer first.")
+
+    def _ctx_index(self, ctx):
+        if ctx is None:
+            return 0
+        for i, c in enumerate(self._ctx_list):
+            if c == ctx:
+                return i
+        raise MXNetError(f"Parameter '{self.name}' was not initialized on "
+                         f"context {ctx}; it is on {self._ctx_list}")
+
+    def data(self, ctx=None) -> NDArray:
+        proxy = self._trace_proxy
+        if proxy is not None:
+            return proxy
+        self._check_initialized(ctx)
+        return self._data[self._ctx_index(ctx)]
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data)
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        if self._grad is None:
+            raise MXNetError(f"Parameter '{self.name}' does not have gradient "
+                             "(grad_req='null')")
+        return self._data[self._ctx_index(ctx)].grad
+
+    def list_grad(self):
+        self._check_initialized()
+        return [d.grad for d in self._data]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return list(self._ctx_list)
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = array(data)
+        if self._data is None:
+            if self._deferred_init:
+                self.shape = data.shape
+                self._finish_deferred_init()
+            else:
+                raise MXNetError(f"Parameter '{self.name}' not initialized")
+        for d in self._data:
+            d._data = data._data.astype(d._data.dtype) \
+                if data._data.dtype != d._data.dtype else data._data
+        return self
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        import jax.numpy as jnp
+        for d in self._data:
+            if d.grad is not None:
+                d.grad._data = jnp.zeros_like(d.grad._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            host = self._data[0]
+            self._data = [host.as_in_context(c) for c in ctx]
+            self._ctx_list = list(ctx)
+            if self._grad_req != 'null':
+                self._init_grad()
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        for d in self._data:
+            d._data = d._data.astype(onp.dtype(dtype))
+        if self._grad is not None:
+            for g in self._grad:
+                g._data = g._data.astype(onp.dtype(dtype))
+
+    def var(self):
+        from .. import symbol
+        return symbol.var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def row_sparse_data(self, row_id):
+        from ..ndarray import sparse
+        return sparse.retain(self.data(), row_id)
+
+    def list_row_sparse_data(self, row_id):
+        return [self.row_sparse_data(row_id)]
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (ref: parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = array(value)
+        self.value = value
+
+        class CInit(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                arr[:] = value.asnumpy()
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req='null', shape=value.shape,
+                         dtype=str(value.dtype), init=CInit())
+
+
+class ParameterDict:
+    """Ref: gluon/parameter.py ParameterDict."""
+
+    def __init__(self, prefix='', shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for p in self._params.values():
+            s += f"  {p}\n"
+        return s + ")"
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == 'shape' and v is not None and existing is not None:
+                        v = tuple(v)
+                        if len(v) == len(existing):
+                            merged = tuple(
+                                e if e > 0 else n for e, n in zip(existing, v))
+                            param.shape = merged
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"No constant named '{name}'")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for p in self.values():
+            s.update(p.list_ctx())
+        return list(s)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=''):
+        arg_dict = {}
+        for p in self.values():
+            weight = p.data().asnumpy() if p._data is not None else None
+            name = p.name
+            if name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = weight
+        import pickle
+        with open(filename, 'wb') as f:
+            pickle.dump(arg_dict, f, protocol=4)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=''):
+        import pickle
+        with open(filename, 'rb') as f:
+            arg_dict = pickle.load(f)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        for name, p in self.items():
+            if name not in arg_dict:
+                if not allow_missing:
+                    raise MXNetError(f"Parameter {name} missing in file")
+                continue
+            if p._data is None and p._deferred_init:
+                p.shape = arg_dict[name].shape
+                p._finish_deferred_init()
+            elif p._data is None:
+                p.initialize(ctx=ctx or [cpu(0)])
+            p.set_data(array(arg_dict[name]))
+        if not ignore_extra:
+            extra = set(arg_dict) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")

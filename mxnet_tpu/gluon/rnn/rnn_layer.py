@@ -1,0 +1,221 @@
+"""Fused RNN layers (ref: python/mxnet/gluon/rnn/rnn_layer.py:306).
+
+Backed by the fused `rnn` op (ops/nn.py, lax.scan over time) — the TPU
+analog of the reference's cuDNN fused RNN kernel.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray, _invoke
+from ... import ndarray as nd
+from ...ops import nn as nn_ops
+from ..block import HybridBlock
+from . import rnn_cell
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode,
+                 projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ('TNC', 'NTC'), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        # register per-layer parameters exactly like the reference so that
+        # saved parameter dicts line up (rnn_layer.py parameter naming)
+        self._layer_params = []
+        for j in range(num_layers):
+            for d in ['l', 'r'][:self._dir]:
+                size = ni if j == 0 else nh * self._dir
+                w_i2h = self.params.get(f'{d}{j}_i2h_weight',
+                                        shape=(ng * nh, size),
+                                        init=i2h_weight_initializer,
+                                        allow_deferred_init=True)
+                w_h2h = self.params.get(f'{d}{j}_h2h_weight',
+                                        shape=(ng * nh, nh),
+                                        init=h2h_weight_initializer,
+                                        allow_deferred_init=True)
+                b_i2h = self.params.get(f'{d}{j}_i2h_bias', shape=(ng * nh,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True)
+                b_h2h = self.params.get(f'{d}{j}_h2h_bias', shape=(ng * nh,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True)
+                setattr(self, f'{d}{j}_i2h_weight', w_i2h)
+                setattr(self, f'{d}{j}_h2h_weight', w_h2h)
+                setattr(self, f'{d}{j}_i2h_bias', b_i2h)
+                setattr(self, f'{d}{j}_h2h_bias', b_h2h)
+                self._layer_params.append((w_i2h, w_h2h, b_i2h, b_h2h))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _finish_deferred(self, inputs):
+        ni = inputs.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        idx = 0
+        for j in range(self._num_layers):
+            for _ in range(self._dir):
+                size = ni if j == 0 else nh * self._dir
+                w_i2h, w_h2h, b_i2h, b_h2h = self._layer_params[idx]
+                if w_i2h._data is None:
+                    w_i2h._finish_deferred_init((ng * nh, size))
+                for p in (w_h2h, b_i2h, b_h2h):
+                    if p._data is None:
+                        p._finish_deferred_init()
+                idx += 1
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(**info))
+        return states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        self._finish_deferred(inputs if self._layout == 'TNC'
+                              else inputs)
+        batch_size = inputs.shape[self._layout.find('N')]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if isinstance(states, NDArray):
+            states = [states]
+        out = self.forward(inputs, states)
+        if skip_states:
+            return out[0]
+        return out
+
+    def forward(self, inputs, states):
+        if self._layout == 'NTC':
+            inputs = inputs.swapaxes(0, 1)
+        ctx = inputs.context
+        # pack parameters into the canonical flat vector
+        flat_ws = []
+        for w_i2h, w_h2h, _, _ in self._layer_params:
+            flat_ws.append(w_i2h.data(ctx).reshape(-1))
+            flat_ws.append(w_h2h.data(ctx).reshape(-1))
+        for _, _, b_i2h, b_h2h in self._layer_params:
+            flat_ws.append(b_i2h.data(ctx).reshape(-1))
+            flat_ws.append(b_h2h.data(ctx).reshape(-1))
+        params_vec = nd.concat(*flat_ws, dim=0)
+        if self._mode == 'lstm':
+            out = _invoke(nn_ops.rnn, inputs, params_vec, states[0], states[1],
+                          state_size=self._hidden_size,
+                          num_layers=self._num_layers, mode=self._mode,
+                          bidirectional=self._dir == 2, p=self._dropout)
+            output, h, c = out
+            new_states = [h, c]
+        else:
+            out = _invoke(nn_ops.rnn, inputs, params_vec, states[0],
+                          state_size=self._hidden_size,
+                          num_layers=self._num_layers, mode=self._mode,
+                          bidirectional=self._dir == 2, p=self._dropout)
+            output, h = out
+            new_states = [h]
+        if self._layout == 'NTC':
+            output = output.swapaxes(0, 1)
+        return output, new_states
+
+    def _unfuse(self):
+        """Return the SequentialRNNCell equivalent (ref: rnn_layer.py:147)."""
+        get_cell = {
+            'rnn_relu': lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation='relu', **kw),
+            'rnn_tanh': lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation='tanh', **kw),
+            'lstm': lambda **kw: rnn_cell.LSTMCell(self._hidden_size, **kw),
+            'gru': lambda **kw: rnn_cell.GRUCell(self._hidden_size, **kw),
+        }[self._mode]
+        stack = rnn_cell.SequentialRNNCell(prefix=self.prefix, params=self.params)
+        with stack.name_scope():
+            ni = self._input_size
+            for i in range(self._num_layers):
+                kwargs = {'input_size': ni}
+                if self._dir == 2:
+                    stack.add(rnn_cell.BidirectionalCell(
+                        get_cell(prefix=f'l{i}_', **kwargs),
+                        get_cell(prefix=f'r{i}_', **kwargs)))
+                else:
+                    stack.add(get_cell(prefix=f'l{i}_', **kwargs))
+                if self._dropout > 0 and i != self._num_layers - 1:
+                    stack.add(rnn_cell.DropoutCell(self._dropout))
+                ni = self._hidden_size * self._dir
+        return stack
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers})")
+
+
+class RNN(_RNNLayer):
+    """Ref: rnn_layer.py RNN."""
+
+    def __init__(self, hidden_size, num_layers=1, activation='relu',
+                 layout='TNC', dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, 'rnn_' + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class LSTM(_RNNLayer):
+    """Ref: rnn_layer.py LSTM."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, 'lstm', projection_size,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'},
+                {'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class GRU(_RNNLayer):
+    """Ref: rnn_layer.py GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, 'gru', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]

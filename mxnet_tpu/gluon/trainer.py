@@ -1,0 +1,170 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py).
+
+API-compatible DP training driver. On a mesh-sharded compiled path the
+gradient all-reduce is emitted by XLA inside the step function; in the
+eager/multi-context path the kvstore reduces across device copies
+(ref: trainer.py:174-261 _init_kvstore, :320 step, :349 allreduce_grads,
+:430 _update).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import ParameterDict, Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore='device',
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("First argument must be a list or dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(f"First argument must contain Parameters, got {type(param)}")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get('rescale_grad', 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._params_to_init = []
+        self._contains_sparse_weight = any(
+            p._stype != 'default' for p in self._params)
+        self._contains_sparse_grad = any(
+            p._grad_stype != 'default' for p in self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = None
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        """Ref: trainer.py:174."""
+        if self._kvstore_type is None or self._kvstore_type is False:
+            self._kvstore = None
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+        else:
+            kv = self._kvstore_type if isinstance(self._kvstore_type, kvs.KVStoreBase) \
+                else kvs.create(self._kvstore_type)
+            self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                # local training prefers updating on workers (ref :195);
+                # dist + sparse forces update_on_kvstore
+                self._update_on_kvstore = bool(self._contains_sparse_weight)
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        # one updater shared across ctxs: reference keeps per-device updaters
+        # but states are per-parameter, so a single updater suffices here.
+        self._updater = opt.get_updater(self._optimizer)
+        # register params into kvstore
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    self._kvstore.init(i, param.data(param.list_ctx()[0]))
+        self._kv_initialized = True
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        idx = self._param2idx[parameter.name]
+        self._kvstore.row_sparse_pull(idx, out=out, row_ids=row_id)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Gradient sync + optimizer update (ref: trainer.py:320)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        """Ref: trainer.py:349."""
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null' or param._data is None:
+                continue
+            grads = param.list_grad()
+            if len(grads) == 1 and self._kvstore.num_workers == 1:
+                continue
+            if self._update_on_kvstore:
+                continue  # push+pull happens in _update via kvstore updater
+            self._kvstore.push(i, grads)
+            self._kvstore.pull(i, grads, ignore_sparse=False)
+
+    def _update(self, ignore_stale_grad=False):
+        """Ref: trainer.py:430."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                if param.grad_req == 'null' or param._data is None:
+                    continue
+                self._kvstore.push(i, param.list_grad())
+                self._kvstore.pull(i, param.list_data())
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null' or param._data is None:
+                continue
+            for data, grad in zip(param.list_data(), param.list_grad()):
+                self._updater(i, grad, data)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def save_states(self, fname):
+        """Ref: trainer.py:463."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, 'wb') as f:
+            f.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Ref: trainer.py:492."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, 'rb') as f:
+            states = f.read()
+        self._updater.set_states(states)
+        if hasattr(self._updater, 'optimizer'):
+            self._optimizer = self._updater.optimizer

@@ -1,0 +1,79 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+from ..ndarray.utils import split_data, split_and_load  # noqa: F401
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Ref: gluon/utils.py clip_global_norm."""
+    import math
+    import jax.numpy as jnp
+
+    assert len(arrays) > 0
+    total = 0.0
+    for arr in arrays:
+        total = total + jnp.sum(jnp.square(arr._data.astype(jnp.float32)))
+    total_norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / (total_norm + 1e-8))
+    tn = float(total_norm)
+    if check_isfinite and not math.isfinite(tn):
+        import warnings
+        warnings.warn(UserWarning('nan or inf is detected.'))
+        return tn
+    for arr in arrays:
+        arr._data = (arr._data * scale).astype(arr._data.dtype)
+    return tn
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, 'rb') as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download stub — this environment has no egress; provide files locally
+    (ref: gluon/utils.py download)."""
+    fname = path if path and not os.path.isdir(path) else \
+        os.path.join(path or '.', url.split('/')[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        f"download({url}) unavailable: no network egress. Place the file at "
+        f"{fname} manually.")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    for dim_size in shape:
+        if dim_size == 0 or dim_size is None:
+            return False
+    return True
+
+
+class HookHandle:
+    def __init__(self):
+        self._hooks_dict_ref = None
+        self._id = None
+
+    def attach(self, hooks_list, hook):
+        hooks_list.append(hook)
+        self._hooks_dict_ref = hooks_list
+        self._id = len(hooks_list) - 1
+
+    def detach(self):
+        if self._hooks_dict_ref:
+            self._hooks_dict_ref.pop(self._id)

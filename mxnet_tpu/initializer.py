@@ -1,0 +1,258 @@
+"""Weight initializers (ref: python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as onp
+
+from .base import Registry, MXNetError
+
+_REG = Registry('initializer')
+register = _REG.register
+
+
+class InitDesc(str):
+    """Descriptor carrying name + attrs (ref: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("initializer first arg must be a name/InitDesc")
+        name = str(desc)
+        init_attr = getattr(desc, 'attrs', {}).get('__init__', '')
+        if init_attr:
+            create(init_attr)._init_weight(name, arr)
+            return
+        if name.endswith('weight'):
+            self._init_weight(name, arr)
+        elif name.endswith('bias'):
+            self._init_bias(name, arr)
+        elif name.endswith('gamma'):
+            self._init_gamma(name, arr)
+        elif name.endswith('beta'):
+            self._init_beta(name, arr)
+        elif name.endswith('running_mean') or name.endswith('moving_mean'):
+            self._init_zero(name, arr)
+        elif name.endswith('running_var') or name.endswith('moving_var'):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def init_weight(self, name, arr):
+        self._init_weight(name, arr)
+
+    def _set(self, arr, value):
+        arr[:] = value
+
+    def _init_zero(self, name, arr):
+        self._set(arr, onp.zeros(arr.shape, dtype='float32'))
+
+    def _init_one(self, name, arr):
+        self._set(arr, onp.ones(arr.shape, dtype='float32'))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_gamma(self, name, arr):
+        self._init_one(name, arr)
+
+    def _init_beta(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, onp.full(arr.shape, self.value, dtype='float32'))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, onp.random.uniform(-self.scale, self.scale,
+                                          arr.shape).astype('float32'))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, onp.random.normal(0, self.sigma, arr.shape).astype('float32'))
+
+
+@register
+class Xavier(Initializer):
+    """Ref: initializer.py Xavier."""
+
+    def __init__(self, rnd_type='uniform', factor_type='avg', magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got shape {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = onp.prod(shape[2:])
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        factor = {'avg': (fan_in + fan_out) / 2.0, 'in': fan_in,
+                  'out': fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == 'uniform':
+            w = onp.random.uniform(-scale, scale, shape)
+        else:
+            w = onp.random.normal(0, scale, shape)
+        self._set(arr, w.astype('float32'))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type='avg', slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__('gaussian', factor_type, magnitude)
+        self._kwargs = {'factor_type': factor_type, 'slope': slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type='uniform'):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        if self.rand_type == 'uniform':
+            tmp = onp.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = onp.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q.reshape(arr.shape)).astype('float32'))
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = onp.zeros(arr.shape, dtype='float32')
+        shape = arr.shape
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight)
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype='float32')
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if isinstance(name, str) and name.startswith('['):
+        import json
+        kind, kw = json.loads(name)
+        return _REG.get(kind)(**kw)
+    return _REG.create(name, **kwargs)
+
+
+class Mixed:
+    """Mix initializers by name pattern (ref: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = [(re.compile(p), init) for p, init in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matched {name}")
+
+
+# `mx.init.*` namespace alias
+class _InitModule:
+    Initializer = Initializer
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Orthogonal = Orthogonal
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    InitDesc = InitDesc
+
+
+init = _InitModule

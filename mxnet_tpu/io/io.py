@@ -1,0 +1,427 @@
+"""Data iterators (ref: python/mxnet/io/io.py and src/io/).
+
+The reference's C++ prefetching pipeline (iter_prefetcher.h) maps to a
+python background-thread prefetcher feeding device via jax device_put —
+host→HBM copies overlap compute because jax dispatch is async.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import queue as _queue
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+
+
+class DataDesc(collections.namedtuple('DataDesc', ['name', 'shape', 'dtype', 'layout'])):
+    def __new__(cls, name, shape, dtype=onp.float32, layout='NCHW'):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find('N')
+
+
+class DataBatch:
+    """Ref: python/mxnet/io/io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data] if self.data else None
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return f"DataBatch: data shapes: {data_shapes} label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Ref: io.py DataIter ABC."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (ref: io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle='pad', data_name='data',
+                 label_name='softmax_label'):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = onp.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        if last_batch_handle == 'discard':
+            self.num_data = (self.num_data // batch_size) * batch_size
+        self.cursor = -batch_size
+        self._cache = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype if hasattr(v, 'dtype') else onp.float32)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype if hasattr(v, 'dtype') else onp.float32)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _take(self, arrs):
+        out = []
+        end = self.cursor + self.batch_size
+        for _, v in arrs:
+            src = v
+            if end <= self.num_data:
+                sel = self.idx[self.cursor:end]
+            else:
+                if self.last_batch_handle == 'roll_over':
+                    raise StopIteration
+                pad = end - self.num_data
+                sel = onp.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+            out.append(array(src[sel]))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = collections.OrderedDict(
+            [(default_name if len(data) == 1 else f"_{i}_{default_name}", d)
+             for i, d in enumerate(data)])
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, onp.asarray(v)))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate/loop) another iterator (ref: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (ref: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        assert len(iters) == 1, "single backing iter supported"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.iter.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class CSVIter(NDArrayIter):
+    """Ref: src/io/iter_csv.cc:218."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        data = onp.loadtxt(data_csv, delimiter=',', dtype=onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=',', dtype=onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size, **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """Ref: src/io/iter_mnist.cc:260; reads idx-format MNIST files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, **kwargs):
+        import gzip
+        import struct
+
+        def read_idx(path):
+            opener = gzip.open if path.endswith('.gz') else open
+            with opener(path, 'rb') as f:
+                magic = struct.unpack('>HBB', f.read(4))
+                dims = struct.unpack('>' + 'I' * magic[2], f.read(4 * magic[2]))
+                return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
+
+        img = read_idx(image).astype(onp.float32) / 255.0
+        lab = read_idx(label).astype(onp.float32)
+        if flat:
+            img = img.reshape(img.shape[0], -1)
+        else:
+            img = img.reshape(img.shape[0], 1, img.shape[1], img.shape[2])
+        super().__init__(img, lab, batch_size, shuffle=shuffle, **kwargs)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO-backed image iterator (ref: src/io/iter_image_recordio_2.cc:880).
+
+    Decodes JPEG/PNG from a .rec file with an index, applies basic
+    augmentations, batches, and prefetches.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=-1, path_imgidx=None, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        self._rec_path = path_imgrec
+        self._record = recordio.MXRecordIO(path_imgrec, 'r')
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = onp.array([mean_r, mean_g, mean_b], onp.float32).reshape(3, 1, 1)
+        self.std = onp.array([std_r, std_g, std_b], onp.float32).reshape(3, 1, 1)
+        self.resize = resize
+        self._items = []
+        self._load_all()
+        self._order = onp.arange(len(self._items))
+        self.cursor = -batch_size
+
+    def _decode_image(self, buf):
+        import io as _io
+        try:
+            from PIL import Image
+            img = onp.asarray(Image.open(_io.BytesIO(buf)).convert('RGB'))
+        except ImportError:
+            raise MXNetError("image decode requires PIL")
+        return img
+
+    def _load_all(self):
+        from .. import recordio
+        while True:
+            s = self._record.read()
+            if s is None:
+                break
+            header, img_bytes = recordio.unpack(s)
+            self._items.append((header.label, img_bytes))
+
+    @property
+    def provide_data(self):
+        return [DataDesc('data', (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc('softmax_label', shape)]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor + self.batch_size <= len(self._items)
+
+    def _augment(self, img):
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            from PIL import Image
+            im = Image.fromarray(img)
+            short = min(im.size)
+            scale = self.resize / short
+            im = im.resize((int(im.size[0] * scale), int(im.size[1] * scale)))
+            img = onp.asarray(im)
+        ih, iw = img.shape[:2]
+        if self.rand_crop and (ih > h or iw > w):
+            y = onp.random.randint(0, ih - h + 1)
+            x = onp.random.randint(0, iw - w + 1)
+        else:
+            y = max(0, (ih - h) // 2)
+            x = max(0, (iw - w) // 2)
+        img = img[y:y + h, x:x + w]
+        if img.shape[0] != h or img.shape[1] != w:
+            from PIL import Image
+            img = onp.asarray(Image.fromarray(img).resize((w, h)))
+        if self.rand_mirror and onp.random.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1).astype(onp.float32)
+        return (chw - self.mean) / self.std
+
+    def getdata(self):
+        batch = []
+        labels = []
+        for i in range(self.cursor, self.cursor + self.batch_size):
+            label, buf = self._items[self._order[i]]
+            img = self._decode_image(buf)
+            batch.append(self._augment(img))
+            labels.append(label)
+        self._labels = onp.array(labels, onp.float32)
+        return [array(onp.stack(batch))]
+
+    def getlabel(self):
+        return [array(self._labels)]
+
+    def getpad(self):
+        return 0

@@ -1,0 +1,4 @@
+from .base import KVStoreBase
+from .kvstore import KVStore, create
+
+__all__ = ['KVStoreBase', 'KVStore', 'create']

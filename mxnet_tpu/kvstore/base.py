@@ -1,0 +1,62 @@
+"""KVStoreBase plugin ABC (ref: python/mxnet/kvstore/base.py:74,220).
+
+The reference allows alternative distributed backends (Horovod) to register
+behind this interface; here the mesh/XLA-collective store registers the
+same way, so `gluon.Trainer` is backend-agnostic.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_STORES = {}
+
+
+class KVStoreBase:
+    """Abstract key-value store interface."""
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    OPTIMIZER = 'optimizer'
+
+    @staticmethod
+    def register(klass):
+        """Register a KVStore backend (ref: base.py:220)."""
+        name = klass.__name__.lower()
+        _STORES[name] = klass
+        return klass
+
+
+def get_kvstore_class(name):
+    key = name.lower()
+    if key not in _STORES:
+        raise MXNetError(f"unknown kvstore type {name!r}; registered: {sorted(_STORES)}")
+    return _STORES[key]

@@ -1,0 +1,36 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Ref: src/kvstore/gradient_compression.h:52-121 — quantize to {-threshold, 0,
++threshold} with residual accumulation. On TPU this runs as a fused XLA
+elementwise pass over the gradient; it models exactly the reference's math
+(compute_expected_2bit_quantization in tests/python/unittest/test_kvstore.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+
+
+class GradientCompression:
+    def __init__(self, ctype='2bit', threshold=0.5):
+        assert ctype in ('none', '2bit')
+        self.type = ctype
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def get_params(self):
+        return {'type': self.type, 'threshold': self.threshold}
+
+    def compress_decompress(self, grad: NDArray, key) -> NDArray:
+        if self.type == 'none':
+            return grad
+        r = self._residual.get(key)
+        g = grad._data.astype(jnp.float32)
+        if r is None:
+            r = jnp.zeros_like(g)
+        acc = r + g
+        t = self.threshold
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
+        self._residual[key] = acc - q
+        return NDArray(q.astype(grad._data.dtype))

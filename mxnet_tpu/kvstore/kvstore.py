@@ -1,0 +1,273 @@
+"""KVStore: gradient aggregation + parameter broadcast.
+
+Ref: src/kvstore/ (KVStoreLocal kvstore_local.h:226, CommDevice comm.h:451,
+KVStoreDist kvstore_dist.h:44) and python/mxnet/kvstore/kvstore.py.
+
+TPU-native design: there are no parameter-server processes and no NCCL —
+reduction across local device copies happens on-device (jax arrays summed;
+XLA emits ICI all-reduce when arrays are sharded over a Mesh), and
+cross-host reduction rides `jax.distributed` + global-device collectives.
+The `local`/`device`/`dist_sync`/`dist_device_sync`/`dist_async` type names
+are preserved so reference scripts run unchanged; `dist_async`'s PS
+semantics collapse to sync allreduce (documented capability difference,
+SURVEY §2.5).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from .base import KVStoreBase
+
+
+class KVStore(KVStoreBase):
+    """In-process store covering 'local' and 'device' modes."""
+
+    def __init__(self, kv_type='local'):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._update_on_kvstore = None
+        self._compression = None
+
+    # --- classic API (ref: include/mxnet/kvstore.h:59) ---------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, vlist in _group(keys, values):
+            merged = _reduce(vlist)
+            if self._compression is not None:
+                merged = self._compression.compress_decompress(merged, k)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                dst._data = jax.device_put(src._data,
+                                           list(dst._data.devices())[0])
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+        elif self._updater is None:
+            # pure allreduce mode: write reduced value back into inputs
+            keys, values = _key_value(key, value)
+            for k, vlist in _group(keys, values):
+                merged = self._store[k]
+                for v in vlist:
+                    v._data = merged._data
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from ..ndarray import sparse as sp
+        keys, outs = _key_value(key, out)
+        row_keys, rows = _key_value(key, row_ids)
+        for k, o, rid in zip(keys, outs, rows):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            full = self._store[k]
+            for dst, r in zip((o if isinstance(o, (list, tuple)) else [o]),
+                              (rid if isinstance(rid, (list, tuple)) else [rid])):
+                retained = sp.retain(full, r)
+                dst._data = retained._data
+
+    # --- updater / optimizer ----------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        from .gradient_compression import GradientCompression
+        ctype = compression_params.get('type', '2bit')
+        threshold = compression_params.get('threshold', 0.5)
+        self._compression = GradientCompression(ctype, threshold)
+
+    # --- distributed attributes --------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return self._type
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in ('optimizer',)
+
+    def barrier(self):
+        from ..ndarray import waitall
+        waitall()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, 'wb') as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, 'rb') as f:
+            self._updater.set_states(f.read())
+
+
+KVStoreBase.register(KVStore)
+
+
+class Local(KVStore):
+    def __init__(self):
+        super().__init__('local')
+
+
+class Device(KVStore):
+    def __init__(self):
+        super().__init__('device')
+
+
+class DistSync(KVStore):
+    """Multi-process synchronous store over jax.distributed.
+
+    Ref mapping: KVStoreDist worker + server (kvstore_dist.h:44,
+    kvstore_dist_server.h:155) collapse into symmetric workers doing a
+    global allreduce — on TPU pods the reduction is an XLA collective over
+    ICI/DCN rather than ps-lite ZMQ traffic.
+    """
+
+    def __init__(self, kv_type='dist_sync'):
+        super().__init__(kv_type)
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        nproc = jax.process_count()
+        for k, vlist in _group(keys, values):
+            merged = _reduce(vlist)
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+                summed = multihost_utils.process_allgather(merged._data)
+                merged = NDArray(summed.sum(axis=0))
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+
+class DistDeviceSync(DistSync):
+    def __init__(self):
+        super().__init__('dist_device_sync')
+
+
+class DistAsync(DistSync):
+    def __init__(self):
+        super().__init__('dist_async')
+
+
+class Horovod(DistSync):
+    """API-compat alias: the mesh store already provides allreduce."""
+
+    def __init__(self):
+        super().__init__('horovod')
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _group(keys, values):
+    """Group (key, [values...]) preserving order (ref: kvstore_local.h:418)."""
+    grouped = {}
+    order = []
+    for k, v in zip(keys, values):
+        if k not in grouped:
+            grouped[k] = []
+            order.append(k)
+        if isinstance(v, (list, tuple)):
+            grouped[k].extend(v)
+        else:
+            grouped[k].append(v)
+    return [(k, grouped[k]) for k in order]
+
+
+def _reduce(vlist):
+    """Sum device copies (ref: CommDevice::Reduce, src/kvstore/comm.h:451)."""
+    if len(vlist) == 1:
+        return NDArray(vlist[0]._data)
+    acc = vlist[0]._data
+    for v in vlist[1:]:
+        acc = acc + v._data
+    return NDArray(acc)
+
+
+_TYPES = {
+    'local': Local,
+    'local_allreduce_cpu': Local,
+    'local_allreduce_device': Device,
+    'device': Device,
+    'nccl': Device,            # NCCL mode maps to on-device reduction
+    'dist_sync': DistSync,
+    'dist_sync_device': DistDeviceSync,
+    'dist_device_sync': DistDeviceSync,
+    'dist_async': DistAsync,
+    'dist': DistSync,
+    'horovod': Horovod,
+}
+
+
+def create(name='local'):
+    """Create a KVStore (ref: src/kvstore/kvstore.cc:41-84)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    key = name.lower()
+    if key not in _TYPES:
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    return _TYPES[key]()

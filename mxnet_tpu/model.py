@@ -1,0 +1,43 @@
+"""Checkpoint helpers for the symbolic API (ref: python/mxnet/model.py)."""
+from __future__ import annotations
+
+import pickle
+
+from . import symbol as sym_mod
+from .ndarray.ndarray import array
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Ref: model.py save_checkpoint — writes prefix-symbol.json and
+    prefix-XXXX.params."""
+    if symbol is not None:
+        symbol.save(f'{prefix}-symbol.json')
+    payload = {f'arg:{k}': v.asnumpy() for k, v in arg_params.items()}
+    payload.update({f'aux:{k}': v.asnumpy() for k, v in aux_params.items()})
+    with open(f'{prefix}-{epoch:04d}.params', 'wb') as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+def load_checkpoint(prefix, epoch):
+    """Ref: model.py load_checkpoint."""
+    symbol = sym_mod.load(f'{prefix}-symbol.json')
+    with open(f'{prefix}-{epoch:04d}.params', 'rb') as f:
+        payload = pickle.load(f)
+    arg_params = {}
+    aux_params = {}
+    for k, v in payload.items():
+        tp, name = k.split(':', 1)
+        if tp == 'arg':
+            arg_params[name] = array(v)
+        else:
+            aux_params[name] = array(v)
+    return symbol, arg_params, aux_params
+
+
+class BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals

@@ -1,0 +1,9 @@
+"""Reference model implementations used by benchmarks and examples.
+
+LeNet (ref: example/gluon/mnist), BERT-base (GluonNLP recipe — the north
+star config), Transformer (example/gluon/transformer shape), built on
+mxnet_tpu.gluon.
+"""
+from .lenet import LeNet
+from .bert import BertModel, BertForPretraining, bert_base_config, bert_pretrain_loss
+from .transformer import TransformerEncoder, TransformerModel

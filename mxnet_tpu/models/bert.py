@@ -1,0 +1,147 @@
+"""BERT for pretraining — the flagship/north-star model.
+
+Ref: the GluonNLP BERT-base recipe named in BASELINE.json; attention kernels
+correspond to the reference's interleaved_matmul selfatt ops
+(src/operator/contrib/transformer.cc:650-828), realised here as the fused
+multi_head_attention op (XLA/Pallas flash path).
+
+bf16-friendly: activations run in the block dtype; layernorm statistics in
+fp32 (see ops/nn.py layer_norm).
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import ndarray as nd
+from ..ops import attention as attn_ops
+from ..ndarray.ndarray import _invoke
+
+
+def bert_base_config():
+    return dict(vocab_size=30522, hidden=768, layers=12, heads=12,
+                intermediate=3072, max_len=512, type_vocab=2)
+
+
+def bert_large_config():
+    return dict(vocab_size=30522, hidden=1024, layers=24, heads=16,
+                intermediate=4096, max_len=512, type_vocab=2)
+
+
+class BertSelfAttention(HybridBlock):
+    def __init__(self, hidden, heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._heads = heads
+        self._hidden = hidden
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * hidden, flatten=False, in_units=hidden)
+            self.proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+            self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        # x: (N, T, C)
+        qkv = self.qkv(x)
+        q, k, v = qkv.split(3, axis=-1)
+        out = _invoke(attn_ops.multi_head_attention, q, k, v, mask,
+                      num_heads=self._heads)
+        return self.dropout(self.proj(out))
+
+
+class BertLayer(HybridBlock):
+    def __init__(self, hidden, heads, intermediate, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = BertSelfAttention(hidden, heads, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=hidden)
+            self.ffn1 = nn.Dense(intermediate, flatten=False, in_units=hidden)
+            self.ffn2 = nn.Dense(hidden, flatten=False, in_units=intermediate)
+            self.ln2 = nn.LayerNorm(in_channels=hidden)
+            self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        attn = self.attention(x, mask)
+        x = self.ln1(x + attn)
+        h = nd.activation(self.ffn1(x), act_type='gelu')
+        h = self.dropout(self.ffn2(h))
+        return self.ln2(x + h)
+
+
+class BertModel(HybridBlock):
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 intermediate=3072, max_len=512, type_vocab=2, dropout=0.1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden = hidden
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, hidden)
+            self.pos_embed = nn.Embedding(max_len, hidden)
+            self.type_embed = nn.Embedding(type_vocab, hidden)
+            self.embed_ln = nn.LayerNorm(in_channels=hidden)
+            self.embed_dropout = nn.Dropout(dropout)
+            self.encoder = nn.HybridSequential(prefix='encoder_')
+            with self.encoder.name_scope():
+                for _ in range(layers):
+                    self.encoder.add(BertLayer(hidden, heads, intermediate,
+                                               dropout))
+            self.pooler = nn.Dense(hidden, flatten=False, in_units=hidden,
+                                   activation='tanh')
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        # tokens: (N, T) int32
+        T = tokens.shape[1]
+        pos = nd.arange(0, T, dtype='int32').reshape(1, T)
+        emb = self.word_embed(tokens) + self.pos_embed(pos)
+        if token_types is not None:
+            emb = emb + self.type_embed(token_types)
+        x = self.embed_dropout(self.embed_ln(emb))
+        mask = None
+        if valid_length is not None:
+            ar = nd.arange(0, T, dtype='float32')
+            mask = (ar.reshape(1, 1, 1, T) <
+                    valid_length.reshape(-1, 1, 1, 1))
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = self.pooler(nd.slice_axis(x, axis=1, begin=0, end=1)
+                             .squeeze(axis=1))
+        return x, pooled
+
+
+class BertForPretraining(HybridBlock):
+    """MLM + NSP heads (the pretraining objective in the north-star recipe)."""
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__(**kwargs)
+        cfg = config or bert_base_config()
+        self._cfg = cfg
+        with self.name_scope():
+            self.bert = BertModel(**cfg)
+            self.mlm_dense = nn.Dense(cfg['hidden'], flatten=False,
+                                      in_units=cfg['hidden'],
+                                      activation='gelu')
+            self.mlm_ln = nn.LayerNorm(in_channels=cfg['hidden'])
+            self.mlm_decoder = nn.Dense(cfg['vocab_size'], flatten=False,
+                                        in_units=cfg['hidden'])
+            self.nsp = nn.Dense(2, in_units=cfg['hidden'])
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        seq, pooled = self.bert(tokens, token_types, valid_length)
+        mlm = self.mlm_decoder(self.mlm_ln(self.mlm_dense(seq)))
+        nsp = self.nsp(pooled)
+        return mlm, nsp
+
+
+def bert_pretrain_loss(mlm_logits, nsp_logits, labels, nsp_labels,
+                       mask_weight=None):
+    """Masked-LM + NSP cross entropy. labels: (N, T) with -1 for unmasked."""
+    logp = nd.log_softmax(mlm_logits, axis=-1)
+    valid = (labels >= 0)
+    safe_labels = nd.where(valid, labels,
+                           nd.zeros_like(labels))
+    token_loss = -nd.pick(logp, safe_labels, axis=-1)
+    token_loss = token_loss * valid
+    denom = nd.sum(valid) + 1e-6
+    mlm_loss = nd.sum(token_loss) / denom
+    nsp_logp = nd.log_softmax(nsp_logits, axis=-1)
+    nsp_loss = nd.mean(-nd.pick(nsp_logp, nsp_labels, axis=-1))
+    return mlm_loss + nsp_loss

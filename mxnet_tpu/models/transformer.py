@@ -1,0 +1,136 @@
+"""Transformer encoder-decoder (ref: example/gluon transformer / the
+contrib interleaved attention ops, src/operator/contrib/transformer.cc)."""
+from __future__ import annotations
+
+import math
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import ndarray as nd
+from ..ops import attention as attn_ops
+from ..ndarray.ndarray import _invoke
+
+
+class PositionalEncoding(HybridBlock):
+    def __init__(self, hidden, max_len=1024, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        import numpy as onp
+        pe = onp.zeros((max_len, hidden), onp.float32)
+        position = onp.arange(max_len)[:, None].astype(onp.float32)
+        div = onp.exp(onp.arange(0, hidden, 2) * (-math.log(10000.0) / hidden))
+        pe[:, 0::2] = onp.sin(position * div)
+        pe[:, 1::2] = onp.cos(position * div)
+        self.pe = self.params.get_constant('pe', pe)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        T = x.shape[1]
+        pe = self.pe.data(x.context)
+        return self.dropout(x + nd.slice_axis(pe, axis=0, begin=0, end=T)
+                            .expand_dims(0))
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, hidden, heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._heads = heads
+        with self.name_scope():
+            self.q_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+            self.k_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+            self.v_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+            self.out_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+
+    def forward(self, q, k, v, mask=None, causal=False):
+        out = _invoke(attn_ops.multi_head_attention,
+                      self.q_proj(q), self.k_proj(k), self.v_proj(v), mask,
+                      num_heads=self._heads, causal=causal)
+        return self.out_proj(out)
+
+
+class EncoderLayer(HybridBlock):
+    def __init__(self, hidden, heads, ffn_hidden, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(hidden, heads, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=hidden)
+            self.ffn1 = nn.Dense(ffn_hidden, flatten=False, in_units=hidden)
+            self.ffn2 = nn.Dense(hidden, flatten=False, in_units=ffn_hidden)
+            self.ln2 = nn.LayerNorm(in_channels=hidden)
+            self.drop = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        x = self.ln1(x + self.drop(self.attn(x, x, x, mask)))
+        h = self.ffn2(nd.activation(self.ffn1(x), act_type='relu'))
+        return self.ln2(x + self.drop(h))
+
+
+class DecoderLayer(HybridBlock):
+    def __init__(self, hidden, heads, ffn_hidden, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(hidden, heads, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=hidden)
+            self.cross_attn = MultiHeadAttention(hidden, heads, dropout)
+            self.ln2 = nn.LayerNorm(in_channels=hidden)
+            self.ffn1 = nn.Dense(ffn_hidden, flatten=False, in_units=hidden)
+            self.ffn2 = nn.Dense(hidden, flatten=False, in_units=ffn_hidden)
+            self.ln3 = nn.LayerNorm(in_channels=hidden)
+            self.drop = nn.Dropout(dropout)
+
+    def forward(self, x, memory, mem_mask=None):
+        x = self.ln1(x + self.drop(self.self_attn(x, x, x, causal=True)))
+        x = self.ln2(x + self.drop(self.cross_attn(x, memory, memory,
+                                                   mem_mask)))
+        h = self.ffn2(nd.activation(self.ffn1(x), act_type='relu'))
+        return self.ln3(x + self.drop(h))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, vocab_size, hidden=512, layers=6, heads=8,
+                 ffn_hidden=2048, max_len=1024, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden = hidden
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, hidden)
+            self.pos = PositionalEncoding(hidden, max_len, dropout)
+            self.layers = nn.HybridSequential(prefix='layers_')
+            with self.layers.name_scope():
+                for _ in range(layers):
+                    self.layers.add(EncoderLayer(hidden, heads, ffn_hidden,
+                                                 dropout))
+
+    def forward(self, tokens, mask=None):
+        x = self.pos(self.embed(tokens) * math.sqrt(self._hidden))
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class TransformerModel(HybridBlock):
+    """Full enc-dec (transformer-big when hidden=1024, heads=16)."""
+
+    def __init__(self, src_vocab, tgt_vocab, hidden=512, enc_layers=6,
+                 dec_layers=6, heads=8, ffn_hidden=2048, max_len=1024,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden = hidden
+        with self.name_scope():
+            self.encoder = TransformerEncoder(src_vocab, hidden, enc_layers,
+                                              heads, ffn_hidden, max_len,
+                                              dropout)
+            self.tgt_embed = nn.Embedding(tgt_vocab, hidden)
+            self.tgt_pos = PositionalEncoding(hidden, max_len, dropout)
+            self.dec_layers = nn.HybridSequential(prefix='dec_')
+            with self.dec_layers.name_scope():
+                for _ in range(dec_layers):
+                    self.dec_layers.add(DecoderLayer(hidden, heads,
+                                                     ffn_hidden, dropout))
+            self.out_proj = nn.Dense(tgt_vocab, flatten=False,
+                                     in_units=hidden)
+
+    def forward(self, src_tokens, tgt_tokens, src_mask=None):
+        memory = self.encoder(src_tokens, src_mask)
+        x = self.tgt_pos(self.tgt_embed(tgt_tokens) * math.sqrt(self._hidden))
+        for layer in self.dec_layers:
+            x = layer(x, memory, src_mask)
+        return self.out_proj(x)

@@ -1,0 +1,520 @@
+"""Module API: legacy symbolic training loop (ref: python/mxnet/module/).
+
+BaseModule.fit (base_module.py:409), Module (module.py), BucketingModule
+(bucketing_module.py). Data-parallel slicing over contexts follows
+DataParallelExecutorGroup.decide_slices (executor_group.py:282); each
+context gets its own compiled Executor.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray.ndarray import NDArray, array, zeros as nd_zeros
+from .ndarray.utils import split_data
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from . import initializer as init_mod
+from .model import BatchEndParam, save_checkpoint, load_checkpoint
+from . import symbol as sym_mod
+
+
+class BaseModule:
+    """Ref: module/base_module.py BaseModule."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self._symbol = None
+
+    def forward_backward(self, data_batch):
+        """Ref: base_module.py:193."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
+              score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+            if batch_end_callback is not None:
+                bec = BatchEndParam(epoch, nbatch, eval_metric)
+                for cb in _as_list(batch_end_callback):
+                    cb(bec)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            pad = eval_batch.pad or 0
+            out = self.get_outputs()[0]
+            real = out.shape[0] - pad
+            outputs.append(out[0:real] if pad else out)
+        if merge_batches:
+            from .ndarray import concat
+            return concat(*outputs, dim=0) if len(outputs) > 1 else outputs[0]
+        return outputs
+
+    def fit(self, train_data, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None, kvstore='local',
+            optimizer='sgd', optimizer_params=(('learning_rate', 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """Training loop (ref: base_module.py:409)."""
+        assert num_epoch is not None, 'please specify number of epochs'
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for data_batch in train_data:
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    bec = BatchEndParam(epoch, nbatch, eval_metric)
+                    for cb in _as_list(batch_end_callback):
+                        cb(bec)
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_params, aux_params = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info('Epoch[%d] Validation-%s=%f', epoch, name, val)
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    # abstract methods
+    def bind(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def init_params(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+    def get_outputs(self):
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError
+
+
+def _as_list(obj):
+    if isinstance(obj, (list, tuple)):
+        return obj
+    return [obj]
+
+
+class Module(BaseModule):
+    """Ref: module/module.py Module. One Executor per context; batches are
+    sliced over contexts like DataParallelExecutorGroup."""
+
+    def __init__(self, symbol, data_names=('data',), label_names=('softmax_label',),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        if context is None:
+            context = [cpu()]
+        if not isinstance(context, (list, tuple)):
+            context = [context]
+        self._context = list(context)
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._arg_params = None
+        self._aux_params = None
+        self._execs = []
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req='write'):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, 'name') else desc[:2]
+            shapes[name] = shape
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = (desc.name, desc.shape) if hasattr(desc, 'name') else desc[:2]
+                shapes[name] = shape
+        self._data_shapes = shapes
+        n = len(self._context)
+        self._execs = []
+        for i, ctx in enumerate(self._context):
+            ctx_shapes = {}
+            for name, shape in shapes.items():
+                if name in self._data_names or name in self._label_names:
+                    b = shape[0] // n
+                    ctx_shapes[name] = (b,) + tuple(shape[1:])
+                else:
+                    ctx_shapes[name] = shape
+            # fill missing arg shapes by inference
+            arg_names = self._symbol.list_arguments()
+            inferred, _, _ = self._symbol.infer_shape(
+                **{k: v for k, v in ctx_shapes.items() if k in arg_names}) \
+                if all(a in ctx_shapes for a in arg_names) else (None, None, None)
+            if inferred is None:
+                # partial: infer param shapes from data shapes via eval_shape
+                inferred_shapes = _infer_missing(self._symbol, ctx_shapes)
+                ctx_shapes.update(inferred_shapes)
+            req = 'null' if not for_training else grad_req
+            self._execs.append(self._symbol.simple_bind(
+                ctx, grad_req=req, **ctx_shapes))
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, 'call bind before initializing the parameters'
+        initializer = initializer or init_mod.Uniform(0.01)
+        param_names = [n for n in self._symbol.list_arguments()
+                       if n not in self._data_names and n not in self._label_names]
+        self._arg_params = {}
+        for name in param_names:
+            arr = self._execs[0].arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._data = arg_params[name]._data
+            else:
+                host = nd_zeros(arr.shape)
+                initializer(init_mod.InitDesc(name), host)
+                arr._data = host._data
+            self._arg_params[name] = arr
+            for e in self._execs[1:]:
+                e.arg_dict[name]._data = arr._data
+        self._aux_params = {}
+        self.params_initialized = True
+
+    def get_params(self):
+        return dict(self._arg_params), dict(self._aux_params or {})
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(None, arg_params, aux_params, allow_missing,
+                         force_init, allow_extra)
+
+    def init_optimizer(self, kvstore='local', optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params)
+            optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        n = len(self._execs)
+        data_slices = [split_data(d, n) for d in data_batch.data]
+        label_slices = [split_data(l, n) for l in (data_batch.label or [])]
+        for i, e in enumerate(self._execs):
+            feed = {}
+            for name, slices in zip(self._data_names, data_slices):
+                feed[name] = slices[i]
+            for name, slices in zip(self._label_names, label_slices):
+                feed[name] = slices[i]
+            e.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for e in self._execs:
+            e.backward(out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        param_names = list(self._arg_params)
+        for idx, name in enumerate(param_names):
+            if name in self._fixed_param_names:
+                continue
+            # sum gradient over executors (DP reduce)
+            grads = [e.grad_dict[name] for e in self._execs
+                     if name in e.grad_dict]
+            if not grads:
+                continue
+            total = grads[0]
+            for g in grads[1:]:
+                total = total + g
+            weight = self._arg_params[name]
+            self._updater(idx, total, weight)
+            for e in self._execs:
+                e.arg_dict[name]._data = weight._data
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = [e.outputs[0] for e in self._execs]
+        if merge_multi_context and len(outs) > 1:
+            from .ndarray import concat
+            return [concat(*outs, dim=0)]
+        return outs if not merge_multi_context else outs
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        outputs = self.get_outputs()
+        eval_metric.update(labels, outputs)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            with open(f'{prefix}-{epoch:04d}.states', 'wb') as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        return mod
+
+
+def _infer_missing(symbol, known_shapes):
+    """Infer missing arg shapes given the bound data/label shapes by running
+    shape propagation down the DAG (lightweight InferShape pass)."""
+    import jax
+
+    names = symbol.list_arguments()
+    missing = [n for n in names if n not in known_shapes]
+    if not missing:
+        return {}
+    inferred = {}
+    # deferred-style: probe with shape hints via attrs on variables
+    for n in missing:
+        node = _find_var(symbol, n)
+        hint = node.attrs.get('__shape__') if node is not None else None
+        if hint:
+            inferred[n] = tuple(hint)
+        else:
+            raise MXNetError(
+                f"cannot infer shape for argument '{n}'; pass it to bind() "
+                "or declare shape on the variable")
+    return inferred
+
+
+def _find_var(symbol, name):
+    found = [None]
+
+    def visit(s):
+        if s.op is None and s._name == name:
+            found[0] = s
+        for i in s.inputs:
+            visit(i)
+    visit(symbol)
+    return found[0]
+
+
+class BucketingModule(BaseModule):
+    """Variable-length sequence training (ref: module/bucketing_module.py).
+
+    On TPU this is the shape-bucketed compile cache: one Module per bucket
+    key, sharing parameters."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    def _gen_module(self, bucket_key, data_shapes=None, label_shapes=None):
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(symbol, data_names, label_names,
+                         logger=self.logger, context=self._context)
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        self._curr_module = self._gen_module(self._default_bucket_key)
+        self._curr_bucket_key = self._default_bucket_key
+        self._curr_module.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind)
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self._curr_module.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                force_init=True)
+                mod.optimizer_initialized = self._curr_module.optimizer_initialized
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, *args, **kwargs):
+        self._curr_module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, *args, **kwargs):
+        self._curr_module.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+        for mod in self._buckets.values():
+            if mod is not self._curr_module and mod.binded:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        if data_batch.bucket_key is not None and \
+                data_batch.bucket_key != self._curr_bucket_key:
+            self.switch_bucket(data_batch.bucket_key,
+                               data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+
+class SequentialModule(BaseModule):
+    """Chain of modules (ref: module/sequential_module.py)."""
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules = []
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True, **kwargs):
+        shapes = data_shapes
+        for mod in self._modules:
+            mod.bind(shapes, label_shapes, for_training)
+        self.binded = True
+
+    def init_params(self, *args, **kwargs):
+        for mod in self._modules:
+            mod.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        from .io import DataBatch
+        cur = data_batch
+        for mod in self._modules:
+            mod.forward(cur, is_train)
+            out = mod.get_outputs()
+            cur = DataBatch(data=out, label=data_batch.label)
+
+    def backward(self, out_grads=None):
+        for mod in reversed(self._modules):
+            mod.backward(out_grads)
+
+    def update(self):
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self):
+        return self._modules[-1].get_outputs()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._modules[-1].update_metric(eval_metric, labels)

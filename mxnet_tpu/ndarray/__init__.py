@@ -1,0 +1,19 @@
+"""The `nd` namespace: NDArray plus every registered op as a function.
+
+Ref: python/mxnet/ndarray/__init__.py. `mx.nd.<op>(...)` works for all ops
+in mxnet_tpu.ops; wrappers are generated from the registry at import.
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
+                      concat, stack, save, load, imperative_invoke, waitall,
+                      from_numpy, from_dlpack, to_dlpack_for_read, _invoke,
+                      _wrap)
+from . import register as _register
+from . import random      # noqa: F401
+from . import linalg      # noqa: F401
+from . import sparse      # noqa: F401
+from .utils import split_data, split_and_load  # noqa: F401
+
+# populate module namespace with op wrappers (skip names already defined,
+# e.g. creation ops which have ctx-aware python front-ends here)
+_register.populate(globals(), skip=('zeros', 'ones', 'full', 'arange',
+                                    'concat', 'stack'))

@@ -1,0 +1,65 @@
+"""`nd.random` namespace (ref: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .ndarray import NDArray, _invoke, _wrap
+from ..ops import random_ops as _r
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype='float32', ctx=None, out=None, **kwargs):
+    if isinstance(low, NDArray):
+        return _invoke(_r.sample_uniform, low, high, shape=_shape(shape), dtype=dtype)
+    return _wrap(_r.random_uniform(low=low, high=high, shape=_shape(shape), dtype=dtype))
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype='float32', ctx=None, out=None, **kwargs):
+    if isinstance(loc, NDArray):
+        return _invoke(_r.sample_normal, loc, scale, shape=_shape(shape), dtype=dtype)
+    return _wrap(_r.random_normal(loc=loc, scale=scale, shape=_shape(shape), dtype=dtype))
+
+
+randn = normal
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype='float32', ctx=None, out=None, **kwargs):
+    if isinstance(alpha, NDArray):
+        return _invoke(_r.sample_gamma, alpha, beta, shape=_shape(shape), dtype=dtype)
+    return _wrap(_r.random_gamma(alpha=alpha, beta=beta, shape=_shape(shape), dtype=dtype))
+
+
+def exponential(scale=1.0, shape=None, dtype='float32', ctx=None, out=None, **kwargs):
+    return _wrap(_r.random_exponential(lam=1.0 / scale, shape=_shape(shape), dtype=dtype))
+
+
+def poisson(lam=1.0, shape=None, dtype='float32', ctx=None, out=None, **kwargs):
+    return _wrap(_r.random_poisson(lam=lam, shape=_shape(shape), dtype=dtype))
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype='float32', ctx=None, **kwargs):
+    return _wrap(_r.random_negative_binomial(k=k, p=p, shape=_shape(shape), dtype=dtype))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype='float32',
+                                  ctx=None, **kwargs):
+    return _wrap(_r.random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=_shape(shape), dtype=dtype))
+
+
+def randint(low, high, shape=None, dtype='int32', ctx=None, out=None, **kwargs):
+    return _wrap(_r.random_randint(low=low, high=high, shape=_shape(shape), dtype=dtype))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype='int32', **kwargs):
+    return _invoke(_r.sample_multinomial, data, shape=_shape(shape) if shape else (),
+                   get_prob=get_prob, dtype=dtype)
+
+
+def shuffle(data, **kwargs):
+    return _invoke(_r.shuffle, data)

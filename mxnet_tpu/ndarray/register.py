@@ -1,0 +1,31 @@
+"""Autogenerate NDArray-level wrappers for every registered op.
+
+Ref: python/mxnet/ndarray/register.py — the reference generates Python
+functions from the C op registry at import time; we generate from the
+in-process registry.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import _OP_REGISTRY
+from .ndarray import _invoke
+
+
+def make_wrapper(opdef):
+    @functools.wraps(opdef.fn)
+    def wrapper(*args, **kwargs):
+        kwargs.pop('out', None)
+        kwargs.pop('name', None)
+        return _invoke(opdef.fn, *args, **kwargs)
+    wrapper.__name__ = opdef.name
+    wrapper.__qualname__ = opdef.name
+    return wrapper
+
+
+def populate(namespace: dict, skip=()):
+    for name, opdef in _OP_REGISTRY.items():
+        if name in skip or name in namespace:
+            continue
+        namespace[name] = make_wrapper(opdef)
+    return namespace

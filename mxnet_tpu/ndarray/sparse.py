@@ -1,0 +1,165 @@
+"""Sparse NDArray surface: CSRNDArray and RowSparseNDArray.
+
+Ref: python/mxnet/ndarray/sparse.py:300,574 and src/operator sparse kernels.
+
+TPU-first design decision (see SURVEY §7 hard parts (e)): XLA has no sparse
+HBM formats, and the reference's sparse workflows (row-sparse kvstore pulls,
+sparse embedding grads) map on TPU to dense gather/scatter which the MXU and
+vector units handle at full bandwidth. We therefore keep the *API* — stype,
+indices/indptr/data accessors, tostype conversions, sparse creation — with a
+dense jax.Array payload plus lazily-computed compressed views. Math on these
+arrays is exact and runs the dense path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+    @property
+    def density(self):
+        a = self.asnumpy()
+        return float((a != 0).sum()) / max(1, a.size)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ref: sparse.py:300)."""
+    __slots__ = ()
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx)
+        self._stype = 'csr'
+
+    def _csr_parts(self):
+        a = self.asnumpy()
+        indptr = [0]
+        indices = []
+        data = []
+        for row in a:
+            nz = onp.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return (onp.array(data, a.dtype), onp.array(indices, onp.int64),
+                onp.array(indptr, onp.int64))
+
+    @property
+    def data(self):
+        return _dense_array(self._csr_parts()[0])
+
+    @property
+    def indptr(self):
+        return _dense_array(self._csr_parts()[2])
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+
+# fix the broken indices property above cleanly
+def _csr_indices(self):
+    return _dense_array(self._csr_parts()[1])
+
+
+CSRNDArray.indices = property(_csr_indices)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array (ref: sparse.py:574): rows explicitly stored by index."""
+    __slots__ = ()
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx)
+        self._stype = 'row_sparse'
+
+    @property
+    def indices(self):
+        a = self.asnumpy().reshape(self.shape[0], -1)
+        nz = onp.nonzero((a != 0).any(axis=1))[0]
+        return _dense_array(nz.astype(onp.int64))
+
+    @property
+    def data(self):
+        a = self.asnumpy()
+        nz = onp.asarray(self.indices.asnumpy(), onp.int64)
+        return _dense_array(a[nz])
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype='float32'):
+    """Create a CSRNDArray from (data, indices, indptr) or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = onp.asarray(data if not isinstance(data, NDArray) else data.asnumpy())
+        indices = onp.asarray(indices if not isinstance(indices, NDArray)
+                              else indices.asnumpy(), onp.int64)
+        indptr = onp.asarray(indptr if not isinstance(indptr, NDArray)
+                             else indptr.asnumpy(), onp.int64)
+        dense = onp.zeros(shape, dtype=dtype)
+        for r in range(shape[0]):
+            for j in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[j]] = data[j]
+        return CSRNDArray(jnp.asarray(dense))
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    return CSRNDArray(jnp.asarray(src.astype(dtype)))
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype='float32'):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = onp.asarray(data if not isinstance(data, NDArray) else data.asnumpy())
+        indices = onp.asarray(indices if not isinstance(indices, NDArray)
+                              else indices.asnumpy(), onp.int64)
+        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
+        dense = onp.zeros(full_shape, dtype=dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense))
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    return RowSparseNDArray(jnp.asarray(src.astype(dtype)))
+
+
+def cast_storage(arr, stype):
+    """Ref: src/operator/tensor/cast_storage.cc."""
+    if stype == 'default':
+        out = NDArray(arr._data, arr._ctx)
+        return out
+    if stype == 'csr':
+        if arr.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        return CSRNDArray(arr._data, arr._ctx)
+    if stype == 'row_sparse':
+        return RowSparseNDArray(arr._data, arr._ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def retain(arr, indices):
+    """Keep only given rows (ref: src/operator/tensor/sparse_retain.cc)."""
+    idx = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
+        else jnp.asarray(indices, jnp.int32)
+    mask = jnp.zeros((arr.shape[0],), bool).at[idx].set(True)
+    shape = (arr.shape[0],) + (1,) * (arr.ndim - 1)
+    out = jnp.where(mask.reshape(shape), arr._data, 0)
+    return RowSparseNDArray(out, arr._ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype='float32'):
+    from .ndarray import zeros as _z
+    return cast_storage(_z(shape, ctx, dtype), stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    from . import dot as _dot
+    return _dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,322 @@
+"""mx.np — NumPy-compatible frontend (ref: python/mxnet/numpy/multiarray.py).
+
+Arrays here are thin wrappers over jax.Array with numpy semantics (true
+scalars, zero-dim shapes, numpy broadcasting). Functions delegate to
+jax.numpy, so everything lowers to XLA exactly like the nd namespace; the
+`ndarray` type interoperates with mx.nd.NDArray via as_nd_ndarray /
+as_np_ndarray.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray as _NDArray
+from .. import random as _framework_random
+
+
+class ndarray(_NDArray):
+    __slots__ = ()
+
+    def as_nd_ndarray(self):
+        return _NDArray(self._data)
+
+    def __getitem__(self, key):
+        if isinstance(key, ndarray):
+            key = key._data
+        out = self._data[key]
+        return ndarray(out)
+
+    def __repr__(self):
+        return f"array({self.asnumpy()})"
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    @property
+    def T(self):
+        return ndarray(self._data.T)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return ndarray(jnp.reshape(self._data, shape))
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return ndarray(jnp.transpose(self._data, axes or None))
+
+    def astype(self, dtype, copy=True):
+        return ndarray(self._data.astype(_onp.dtype(dtype)))
+
+    def copy(self):
+        return ndarray(self._data)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def _b(self, other, fn):
+        if isinstance(other, _NDArray):
+            other = other._data
+        return ndarray(fn(self._data, other))
+
+    def __add__(self, other):
+        return self._b(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._b(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._b(other, lambda a, b: jnp.subtract(b, a))
+
+    def __mul__(self, other):
+        return self._b(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._b(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._b(other, lambda a, b: jnp.divide(b, a))
+
+    def __pow__(self, other):
+        return self._b(other, jnp.power)
+
+    def __mod__(self, other):
+        return self._b(other, jnp.mod)
+
+    def __matmul__(self, other):
+        return self._b(other, jnp.matmul)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._b(other, jnp.equal)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._b(other, jnp.not_equal)
+
+    def __gt__(self, other):
+        return self._b(other, jnp.greater)
+
+    def __ge__(self, other):
+        return self._b(other, jnp.greater_equal)
+
+    def __lt__(self, other):
+        return self._b(other, jnp.less)
+
+    def __le__(self, other):
+        return self._b(other, jnp.less_equal)
+
+    __hash__ = object.__hash__
+
+
+def array(obj, dtype=None, ctx=None):
+    if isinstance(obj, _NDArray):
+        obj = obj._data
+    return ndarray(jnp.asarray(obj, dtype=_onp.dtype(dtype) if dtype else None))
+
+
+def _unwrap(x):
+    if isinstance(x, _NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(i) for i in x)
+    return x
+
+
+def _make(fname):
+    jfn = getattr(jnp, fname)
+
+    def fn(*args, **kwargs):
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        kwargs.pop('ctx', None)
+        kwargs.pop('out', None)
+        out = jfn(*args, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(ndarray(o) if hasattr(o, 'shape') else o for o in out)
+        return ndarray(out) if hasattr(out, 'shape') else out
+    fn.__name__ = fname
+    return fn
+
+
+_FUNCS = [
+    'zeros', 'ones', 'full', 'empty', 'arange', 'linspace', 'logspace', 'eye',
+    'identity', 'zeros_like', 'ones_like', 'full_like', 'add', 'subtract',
+    'multiply', 'divide', 'true_divide', 'mod', 'remainder', 'power', 'matmul',
+    'dot', 'inner', 'outer', 'tensordot', 'einsum', 'sqrt', 'cbrt', 'square',
+    'exp', 'expm1', 'log', 'log2', 'log10', 'log1p', 'sin', 'cos', 'tan',
+    'arcsin', 'arccos', 'arctan', 'arctan2', 'sinh', 'cosh', 'tanh', 'arcsinh',
+    'arccosh', 'arctanh', 'degrees', 'radians', 'abs', 'absolute', 'fabs',
+    'sign', 'floor', 'ceil', 'trunc', 'rint', 'fix', 'around', 'round',
+    'reciprocal', 'negative', 'maximum', 'minimum', 'clip', 'sum', 'prod',
+    'mean', 'std', 'var', 'min', 'max', 'amin', 'amax', 'argmin', 'argmax',
+    'cumsum', 'cumprod', 'reshape', 'ravel', 'transpose', 'swapaxes',
+    'moveaxis', 'rollaxis', 'expand_dims', 'squeeze', 'concatenate', 'stack',
+    'vstack', 'hstack', 'dstack', 'column_stack', 'split', 'array_split',
+    'hsplit', 'vsplit', 'dsplit', 'tile', 'repeat', 'flip', 'fliplr', 'flipud',
+    'roll', 'rot90', 'where', 'take', 'take_along_axis', 'choose', 'compress',
+    'diag', 'diagonal', 'diagflat', 'tril', 'triu', 'trace', 'sort', 'argsort',
+    'partition', 'unique', 'nonzero', 'count_nonzero', 'searchsorted',
+    'broadcast_to', 'broadcast_arrays', 'atleast_1d', 'atleast_2d',
+    'atleast_3d', 'meshgrid', 'indices', 'logical_and', 'logical_or',
+    'logical_not', 'logical_xor', 'equal', 'not_equal', 'greater',
+    'greater_equal', 'less', 'less_equal', 'isnan', 'isinf', 'isfinite',
+    'isclose', 'allclose', 'array_equal', 'floor_divide', 'float_power',
+    'hypot', 'lcm', 'gcd', 'bitwise_and', 'bitwise_or', 'bitwise_xor',
+    'invert', 'left_shift', 'right_shift', 'nan_to_num', 'interp', 'histogram',
+    'bincount', 'percentile', 'quantile', 'median', 'average', 'cov',
+    'corrcoef', 'convolve', 'correlate', 'gradient', 'diff', 'ediff1d',
+    'cross', 'kron', 'vdot', 'pad', 'insert', 'delete', 'append', 'resize',
+    'trim_zeros', 'tril_indices', 'polyval', 'vander',
+]
+
+for _f in _FUNCS:
+    if hasattr(jnp, _f):
+        globals()[_f] = _make(_f)
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int32 = _onp.int32
+int64 = _onp.int64
+int8 = _onp.int8
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+
+dtype = _onp.dtype
+
+
+class random:
+    """np.random namespace backed by the framework key stream."""
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, size=None, dtype='float32', ctx=None):
+        key = _framework_random.next_key()
+        size = size if size is not None else ()
+        if isinstance(size, int):
+            size = (size,)
+        return ndarray(jax.random.uniform(
+            key, size, jnp.dtype(dtype), minval=low, maxval=high))
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, size=None, dtype='float32', ctx=None):
+        key = _framework_random.next_key()
+        size = size if size is not None else ()
+        if isinstance(size, int):
+            size = (size,)
+        return ndarray(loc + scale * jax.random.normal(key, size,
+                                                       jnp.dtype(dtype)))
+
+    @staticmethod
+    def randint(low, high=None, size=None, dtype='int32', ctx=None):
+        key = _framework_random.next_key()
+        if high is None:
+            low, high = 0, low
+        size = size if size is not None else ()
+        if isinstance(size, int):
+            size = (size,)
+        return ndarray(jax.random.randint(key, size, low, high,
+                                          jnp.dtype(dtype)))
+
+    @staticmethod
+    def rand(*size):
+        return random.uniform(size=size or None)
+
+    @staticmethod
+    def randn(*size):
+        return random.normal(size=size or None)
+
+    @staticmethod
+    def choice(a, size=None, replace=True, p=None, ctx=None):
+        key = _framework_random.next_key()
+        a_arr = _unwrap(a) if not isinstance(a, int) else jnp.arange(a)
+        size = size if size is not None else ()
+        if isinstance(size, int):
+            size = (size,)
+        p_arr = _unwrap(p) if p is not None else None
+        return ndarray(jax.random.choice(key, a_arr, size, replace, p_arr))
+
+    @staticmethod
+    def shuffle(x):
+        key = _framework_random.next_key()
+        if isinstance(x, _NDArray):
+            x._data = jax.random.permutation(key, x._data, axis=0)
+            return
+        raise TypeError("shuffle requires an mx.np.ndarray")
+
+    @staticmethod
+    def seed(s):
+        _framework_random.seed(s)
+
+
+class linalg:
+    @staticmethod
+    def norm(x, ord=None, axis=None, keepdims=False):
+        return ndarray(jnp.linalg.norm(_unwrap(x), ord=ord, axis=axis,
+                                       keepdims=keepdims))
+
+    @staticmethod
+    def inv(a):
+        return ndarray(jnp.linalg.inv(_unwrap(a)))
+
+    @staticmethod
+    def det(a):
+        return ndarray(jnp.linalg.det(_unwrap(a)))
+
+    @staticmethod
+    def slogdet(a):
+        s, l = jnp.linalg.slogdet(_unwrap(a))
+        return ndarray(s), ndarray(l)
+
+    @staticmethod
+    def cholesky(a):
+        return ndarray(jnp.linalg.cholesky(_unwrap(a)))
+
+    @staticmethod
+    def svd(a, full_matrices=True, compute_uv=True):
+        out = jnp.linalg.svd(_unwrap(a), full_matrices=full_matrices,
+                             compute_uv=compute_uv)
+        if compute_uv:
+            return tuple(ndarray(o) for o in out)
+        return ndarray(out)
+
+    @staticmethod
+    def eigh(a):
+        w, v = jnp.linalg.eigh(_unwrap(a))
+        return ndarray(w), ndarray(v)
+
+    @staticmethod
+    def solve(a, b):
+        return ndarray(jnp.linalg.solve(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def lstsq(a, b, rcond=None):
+        out = jnp.linalg.lstsq(_unwrap(a), _unwrap(b), rcond=rcond)
+        return tuple(ndarray(o) if hasattr(o, 'shape') else o for o in out)
+
+    @staticmethod
+    def qr(a):
+        q, r = jnp.linalg.qr(_unwrap(a))
+        return ndarray(q), ndarray(r)
+
+    @staticmethod
+    def matrix_rank(a):
+        return ndarray(jnp.linalg.matrix_rank(_unwrap(a)))
+
+    @staticmethod
+    def pinv(a):
+        return ndarray(jnp.linalg.pinv(_unwrap(a)))

@@ -1,0 +1,122 @@
+"""mx.npx — numpy extension ops (ref: python/mxnet/numpy_extension/).
+
+NN ops that have no numpy equivalent, operating on mx.np.ndarray.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..numpy import ndarray, _unwrap
+from ..ops import nn as _nn, index as _idx, sequence as _seq
+from ..util import (set_np, reset_np, is_np_array, is_np_shape,  # noqa: F401
+                    use_np, use_np_array, use_np_shape)
+from ..context import cpu, gpu, num_gpus  # noqa: F401
+
+
+def _wrap_out(out):
+    if isinstance(out, tuple):
+        return tuple(ndarray(o) for o in out)
+    return ndarray(out)
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    return _wrap_out(_nn.softmax(_unwrap(data), axis=axis,
+                                 temperature=temperature,
+                                 length=_unwrap(length) if length is not None else None))
+
+
+def log_softmax(data, axis=-1, temperature=None):
+    return _wrap_out(_nn.log_softmax(_unwrap(data), axis=axis,
+                                     temperature=temperature))
+
+
+def relu(data):
+    return _wrap_out(jnp.maximum(_unwrap(data), 0))
+
+
+def sigmoid(data):
+    return _wrap_out(jax.nn.sigmoid(_unwrap(data)))
+
+
+def activation(data, act_type='relu'):
+    return _wrap_out(_nn.activation(_unwrap(data), act_type=act_type))
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    return _wrap_out(_nn.fully_connected(
+        _unwrap(x), _unwrap(weight),
+        _unwrap(bias) if bias is not None else None,
+        num_hidden=num_hidden, no_bias=no_bias, flatten=flatten))
+
+
+def convolution(data=None, weight=None, bias=None, **kwargs):
+    return _wrap_out(_nn.convolution(
+        _unwrap(data), _unwrap(weight),
+        _unwrap(bias) if bias is not None else None, **kwargs))
+
+
+def pooling(data=None, **kwargs):
+    return _wrap_out(_nn.pooling(_unwrap(data), **kwargs))
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, **kwargs):
+    out, m, v = _nn.batch_norm(_unwrap(x), _unwrap(gamma), _unwrap(beta),
+                               _unwrap(running_mean), _unwrap(running_var),
+                               **kwargs)
+    return ndarray(out)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _wrap_out(_nn.layer_norm(_unwrap(data), _unwrap(gamma),
+                                    _unwrap(beta), axis=axis, eps=eps))
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype='float32',
+              sparse_grad=False):
+    return _wrap_out(_nn.embedding(_unwrap(data), _unwrap(weight)))
+
+
+def topk(data, axis=-1, k=1, ret_typ='indices', is_ascend=False,
+         dtype='float32'):
+    from ..ops.matrix import topk as _topk
+    return _wrap_out(_topk(_unwrap(data), axis=axis, k=k, ret_typ=ret_typ,
+                           is_ascend=is_ascend, dtype=dtype))
+
+
+def pick(data, index, axis=-1, mode='clip', keepdims=False):
+    return _wrap_out(_idx.pick(_unwrap(data), _unwrap(index), axis=axis,
+                               keepdims=keepdims, mode=mode))
+
+
+def one_hot(data, depth=None, on_value=1.0, off_value=0.0, dtype='float32'):
+    return _wrap_out(_nn.one_hot(_unwrap(data), depth=depth,
+                                 on_value=on_value, off_value=off_value,
+                                 dtype=dtype))
+
+
+def gather_nd(data, indices):
+    return _wrap_out(_idx.gather_nd(_unwrap(data), _unwrap(indices)))
+
+
+def reshape_like(lhs, rhs):
+    return _wrap_out(jnp.reshape(_unwrap(lhs), _unwrap(rhs).shape))
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0., axis=0):
+    return _wrap_out(_seq.sequence_mask(
+        _unwrap(data),
+        _unwrap(sequence_length) if sequence_length is not None else None,
+        use_sequence_length=use_sequence_length, value=value, axis=axis))
+
+
+def seed(s):
+    from .. import random as _r
+    _r.seed(s)
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    _w()

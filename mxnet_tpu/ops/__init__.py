@@ -1,0 +1,31 @@
+"""Operator library: pure functions over jax arrays.
+
+TPU-native analog of src/operator/ (ref: SURVEY §2.2). Each op is a pure,
+traceable function lowered by XLA; there is no per-op CUDA kernel — XLA
+fusion replaces the reference's pointwise-fusion RTC pass
+(ref: src/operator/fusion/fused_op.h:58), and Pallas kernels cover the few
+hand-tuned hot spots (attention, fused optimizer updates).
+"""
+from . import elemwise    # noqa: F401
+from . import reduce      # noqa: F401
+from . import matrix      # noqa: F401
+from . import nn          # noqa: F401
+from . import index       # noqa: F401
+from . import init       # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence    # noqa: F401
+from . import attention   # noqa: F401
+from . import contrib     # noqa: F401
+
+from .elemwise import *     # noqa: F401,F403
+from .reduce import *       # noqa: F401,F403
+from .matrix import *       # noqa: F401,F403
+from .nn import *           # noqa: F401,F403
+from .index import *        # noqa: F401,F403
+from .init import *         # noqa: F401,F403
+from .random_ops import *   # noqa: F401,F403
+from .optimizer_ops import *  # noqa: F401,F403
+from .sequence import *     # noqa: F401,F403
+from .attention import *    # noqa: F401,F403
+from .contrib import *      # noqa: F401,F403

@@ -1,0 +1,123 @@
+"""Attention kernels (ref: src/operator/contrib/transformer.cc:650-828).
+
+The reference exposes interleaved-matmul ops over a packed (T, N, 3*H*D)
+projection tensor. We keep that API for parity, plus a fused
+`multi_head_attention` that is the TPU-preferred entry: one call that can be
+swapped between the XLA path and a Pallas flash-attention kernel
+(mxnet_tpu.ops.pallas_attention) by size heuristic.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _split_heads_interleaved(queries_keys_values, num_heads, parts):
+    """(T, N, parts*H*D) interleaved per head → list of (N*H, T, D)."""
+    T, N, tot = queries_keys_values.shape
+    D = tot // (num_heads * parts)
+    x = queries_keys_values.reshape(T, N, num_heads, parts, D)
+    outs = []
+    for p in range(parts):
+        part = x[:, :, :, p, :]                       # (T, N, H, D)
+        part = part.transpose(1, 2, 0, 3)             # (N, H, T, D)
+        outs.append(part.reshape(N * num_heads, T, D))
+    return outs
+
+
+@_reg
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """scores = scaled Q·K^T from packed qkv (ref: transformer.cc:650)."""
+    q, k, _ = _split_heads_interleaved(queries_keys_values, heads, 3)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@_reg
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """out = att·V, re-packed to (T, N, H*D) (ref: transformer.cc:708)."""
+    _, _, v = _split_heads_interleaved(queries_keys_values, heads, 3)
+    out = jnp.matmul(attention, v)                    # (N*H, T, D)
+    NH, T, D = out.shape
+    N = NH // heads
+    out = out.reshape(N, heads, T, D).transpose(2, 0, 1, 3)
+    return out.reshape(T, N, heads * D)
+
+
+@_reg
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Ref: transformer.cc:766. queries (Tq, N, H*D); keys_values (Tk, N, 2*H*D)."""
+    Tq, N, tot = queries.shape
+    D = tot // heads
+    q = queries.reshape(Tq, N, heads, D).transpose(1, 2, 0, 3).reshape(
+        N * heads, Tq, D)
+    k, _ = _split_heads_interleaved(keys_values, heads, 2)
+    scale = 1.0 / math.sqrt(D)
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@_reg
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    _, v = _split_heads_interleaved(keys_values, heads, 2)
+    out = jnp.matmul(attention, v)
+    NH, T, D = out.shape
+    N = NH // heads
+    out = out.reshape(N, heads, T, D).transpose(2, 0, 1, 3)
+    return out.reshape(T, N, heads * D)
+
+
+@_reg
+def div_sqrt_dim(data):
+    """Ref: transformer.cc _contrib_div_sqrt_dim."""
+    return data / math.sqrt(data.shape[-1])
+
+
+@_reg
+def multi_head_attention(query, key, value, mask=None, num_heads=1,
+                         dropout_p=0.0, causal=False, use_pallas='auto'):
+    """Fused MHA on (N, T, H*D)-shaped q/k/v. The TPU-native attention entry.
+
+    use_pallas: 'auto' picks the Pallas flash kernel on TPU for long
+    sequences, plain XLA otherwise (XLA already fuses softmax well at small T).
+    """
+    N, Tq, tot = query.shape
+    H = num_heads
+    D = tot // H
+    q = query.reshape(N, Tq, H, D).transpose(0, 2, 1, 3)
+    k = key.reshape(N, key.shape[1], H, D).transpose(0, 2, 1, 3)
+    v = value.reshape(N, value.shape[1], H, D).transpose(0, 2, 1, 3)
+
+    if use_pallas in ('auto', True):
+        try:
+            from .pallas_attention import flash_attention, pallas_available
+            if pallas_available() and (use_pallas is True or
+                                       (Tq >= 1024 and mask is None)):
+                out = flash_attention(q, k, v, causal=causal)
+                return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
+        except Exception:
+            pass
+
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum('nhqd,nhkd->nhqk', q * scale, k,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        Tk = k.shape[2]
+        cmask = jnp.tril(jnp.ones((Tq, Tk), bool))
+        scores = jnp.where(cmask, scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, -1e30)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum('nhqk,nhkd->nhqd', att, v)
+    return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)

@@ -1,0 +1,357 @@
+"""Contrib / vision / detection ops.
+
+Ref: src/operator/contrib/ (bounding_box.cc, multibox_*.cc, roi_align.cc,
+bilinear_resize.cc, adaptive_avg_pooling.cc...) and src/operator/image/.
+Vectorised lax/jnp formulations; NMS uses a lax.fori_loop suppression sweep
+(static shapes, TPU-friendly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _iou_corner(a, b):
+    """a: (..., M, 4), b: (..., K, 4) corner format → (..., M, K)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@_reg
+def box_iou(lhs, rhs, format='corner'):
+    """Ref: src/operator/contrib/bounding_box.cc box_iou."""
+    if format == 'center':
+        def c2c(x):
+            xy = x[..., :2]
+            wh = x[..., 2:4] / 2
+            return jnp.concatenate([xy - wh, xy + wh], axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+@_reg
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format='corner', out_format='corner'):
+    """Batched NMS (ref: bounding_box.cc box_nms). data: (..., N, K>=6).
+
+    Greedy suppression implemented as a fixed-length fori_loop over
+    score-sorted candidates — static shapes so XLA compiles one kernel.
+    Suppressed entries get score -1 (reference semantics)."""
+    orig_shape = data.shape
+    x = data.reshape((-1,) + orig_shape[-2:])
+    B, N, K = x.shape
+    scores = x[..., score_index]
+    boxes = x[..., coord_start:coord_start + 4]
+    if in_format == 'center':
+        xy = boxes[..., :2]
+        wh = boxes[..., 2:4] / 2
+        boxes = jnp.concatenate([xy - wh, xy + wh], axis=-1)
+    cls_id = x[..., id_index] if id_index >= 0 else jnp.zeros((B, N))
+    valid = scores > valid_thresh
+    if background_id >= 0 and id_index >= 0:
+        valid = jnp.logical_and(valid, cls_id != background_id)
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=-1)
+    if topk > 0:
+        keep_n = min(topk, N)
+    else:
+        keep_n = N
+    sorted_boxes = jnp.take_along_axis(boxes, order[..., None], axis=1)
+    sorted_valid = jnp.take_along_axis(valid, order, axis=1)
+    sorted_cls = jnp.take_along_axis(cls_id, order, axis=1)
+    iou = _iou_corner(sorted_boxes, sorted_boxes)  # (B, N, N)
+    if not force_suppress and id_index >= 0:
+        same = sorted_cls[..., :, None] == sorted_cls[..., None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(i, keep):
+        active = keep[:, i] & sorted_valid[:, i] & (i < keep_n)
+        sup = (iou[:, i, :] > overlap_thresh) & (jnp.arange(N)[None, :] > i)
+        return jnp.where(active[:, None] & sup, False, keep)
+
+    keep = lax.fori_loop(0, N, body, jnp.ones((B, N), bool))
+    keep = keep & sorted_valid & (jnp.arange(N)[None, :] < keep_n)
+    new_scores = jnp.where(keep, jnp.take_along_axis(scores, order, axis=1), -1.0)
+    sorted_x = jnp.take_along_axis(x, order[..., None], axis=1)
+    out = sorted_x.at[..., score_index].set(new_scores)
+    return out.reshape(orig_shape)
+
+
+@_reg
+def bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                      scale_width=None, mode='size', align_corners=True):
+    """Ref: src/operator/contrib/bilinear_resize.cc. NCHW."""
+    n, c, h, w = data.shape
+    if height is None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    if align_corners and height > 1 and width > 1:
+        ys = jnp.linspace(0, h - 1, height)
+        xs = jnp.linspace(0, w - 1, width)
+    else:
+        ys = (jnp.arange(height) + 0.5) * h / height - 0.5
+        xs = (jnp.arange(width) + 0.5) * w / width - 0.5
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(ys - y0, 0, 1)
+    wx = jnp.clip(xs - x0, 0, 1)
+    top = data[:, :, y0][:, :, :, x0] * (1 - wx) + data[:, :, y0][:, :, :, x1] * wx
+    bot = data[:, :, y1][:, :, :, x0] * (1 - wx) + data[:, :, y1][:, :, :, x1] * wx
+    return top * (1 - wy[:, None]) + bot * wy[:, None]
+
+
+@_reg
+def adaptive_avg_pooling2d(data, output_size=(1, 1)):
+    """Ref: src/operator/contrib/adaptive_avg_pooling.cc."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = data.shape
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    # general: interpolation-style averaging via cumulative sums
+    ys = jnp.linspace(0, h, oh + 1)
+    xs = jnp.linspace(0, w, ow + 1)
+    out = jnp.zeros((n, c, oh, ow), data.dtype)
+    rows = []
+    for i in range(oh):
+        y0, y1 = int(ys[i]), int(jnp.ceil(ys[i + 1]))
+        cols = []
+        for j in range(ow):
+            x0, x1 = int(xs[j]), int(jnp.ceil(xs[j + 1]))
+            cols.append(data[:, :, y0:y1, x0:x1].mean(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@_reg
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """Ref: src/operator/contrib/roi_align.cc. data NCHW; rois (R,5)=[b,x1,y1,x2,y2]."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    offset = 0.5 if aligned else 0.0
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, roi[2] * spatial_scale - offset, \
+            roi[3] * spatial_scale - offset, roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bh, bw = rh / ph, rw / pw
+        # sample grid: (ph*sr, pw*sr)
+        gy = y1 + (jnp.arange(ph * sr) + 0.5) * bh / sr
+        gx = x1 + (jnp.arange(pw * sr) + 0.5) * bw / sr
+        img = data[bidx]  # (C, H, W)
+        y0i = jnp.clip(jnp.floor(gy), 0, h - 1).astype(jnp.int32)
+        x0i = jnp.clip(jnp.floor(gx), 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        wy = jnp.clip(gy - y0i, 0, 1)
+        wx = jnp.clip(gx - x0i, 0, 1)
+        tl = img[:, y0i][:, :, x0i]
+        tr = img[:, y0i][:, :, x1i]
+        bl = img[:, y1i][:, :, x0i]
+        br = img[:, y1i][:, :, x1i]
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        samples = top * (1 - wy[:, None]) + bot * wy[:, None]  # (C, ph*sr, pw*sr)
+        samples = samples.reshape(c, ph, sr, pw, sr)
+        valid = jnp.logical_and(gy >= -1, gy <= h).astype(data.dtype)
+        return samples.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@_reg
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5)):
+    """SSD anchor generation (ref: src/operator/contrib/multibox_prior.cc)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = list(sizes)
+    ratios = list(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing='ij')
+    num = len(sizes) + len(ratios) - 1
+    ws, hs = [], []
+    for i in range(num):
+        if i < len(sizes):
+            s = sizes[i]
+            r = ratios[0]
+        else:
+            s = sizes[0]
+            r = ratios[i - len(sizes) + 1]
+        sr = jnp.sqrt(r)
+        ws.append(s * sr / 2 * (h / w if False else 1.0))
+        hs.append(s / sr / 2)
+    anchors = []
+    for wv, hv in zip(ws, hs):
+        anchors.append(jnp.stack([cxg - wv, cyg - hv, cxg + wv, cyg + hv], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@_reg
+def smooth_l1(data, scalar=1.0):
+    """Ref: src/operator/tensor/elemwise_unary_op_basic.cc smooth_l1."""
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@_reg
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        return (start + step * jnp.arange(n)).reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n)
+
+
+@_reg
+def image_normalize(data, mean=(0, 0, 0), std=(1, 1, 1)):
+    """Ref: src/operator/image/image_random.cc Normalize; CHW or NCHW."""
+    mean = jnp.asarray(mean, dtype=data.dtype)
+    std = jnp.asarray(std, dtype=data.dtype)
+    if data.ndim == 3:
+        return (data - mean[:, None, None]) / std[:, None, None]
+    return (data - mean[None, :, None, None]) / std[None, :, None, None]
+
+
+@_reg
+def image_to_tensor(data):
+    """HWC uint8 → CHW float [0,1] (ref: src/operator/image/image_random.cc)."""
+    if data.ndim == 3:
+        return data.transpose(2, 0, 1).astype(jnp.float32) / 255.0
+    return data.transpose(0, 3, 1, 2).astype(jnp.float32) / 255.0
+
+
+@_reg
+def image_resize(data, size=(224, 224), keep_ratio=False, interp=1):
+    """HWC / NHWC resize via jax.image (ref: src/operator/image/resize.cc)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    method = 'nearest' if interp == 0 else 'bilinear'
+    if data.ndim == 3:
+        return jax.image.resize(data, (h, w, data.shape[2]), method=method)
+    return jax.image.resize(data, (data.shape[0], h, w, data.shape[3]),
+                            method=method)
+
+
+@_reg
+def image_crop(data, x=0, y=0, width=1, height=1):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@_reg
+def image_flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@_reg
+def image_flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+@_reg
+def spatial_transformer(data, loc, target_shape=None, transform_type='affine',
+                        sampler_type='bilinear'):
+    """Affine grid + bilinear sample (ref: src/operator/spatial_transformer.cc)."""
+    n, c, h, w = data.shape
+    th, tw = target_shape if target_shape else (h, w)
+    theta = loc.reshape(n, 2, 3)
+    ys = jnp.linspace(-1, 1, th)
+    xs = jnp.linspace(-1, 1, tw)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    grid = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(th * tw)], axis=0)
+    src = jnp.einsum('nij,jk->nik', theta, grid)  # (n, 2, th*tw)
+    sx = (src[:, 0] + 1) * (w - 1) / 2
+    sy = (src[:, 1] + 1) * (h - 1) / 2
+
+    def sample_one(img, sx, sy):
+        x0 = jnp.clip(jnp.floor(sx), 0, w - 1).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(sy), 0, h - 1).astype(jnp.int32)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = jnp.clip(sx - x0, 0, 1)
+        wy = jnp.clip(sy - y0, 0, 1)
+        tl = img[:, y0, x0]
+        tr = img[:, y0, x1]
+        bl = img[:, y1, x0]
+        br = img[:, y1, x1]
+        out = (tl * (1 - wx) * (1 - wy) + tr * wx * (1 - wy)
+               + bl * (1 - wx) * wy + br * wx * wy)
+        return out.reshape(c, th, tw)
+
+    return jax.vmap(sample_one)(data, sx, sy)
+
+
+@_reg
+def grid_generator(data, transform_type='affine', target_shape=None):
+    n = data.shape[0]
+    th, tw = target_shape
+    theta = data.reshape(n, 2, 3)
+    ys = jnp.linspace(-1, 1, th)
+    xs = jnp.linspace(-1, 1, tw)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    grid = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(th * tw)], axis=0)
+    src = jnp.einsum('nij,jk->nik', theta, grid)
+    return src.reshape(n, 2, th, tw)
+
+
+@_reg
+def bilinear_sampler(data, grid):
+    """Ref: src/operator/bilinear_sampler.cc. grid in [-1,1], (N,2,H,W)."""
+    n, c, h, w = data.shape
+    gh, gw = grid.shape[2], grid.shape[3]
+    sx = (grid[:, 0] + 1) * (w - 1) / 2
+    sy = (grid[:, 1] + 1) * (h - 1) / 2
+
+    def sample_one(img, sx, sy):
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = sx - x0
+        wy = sy - y0
+
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            return img[:, yc, xc] * valid
+
+        out = (at(y0, x0) * (1 - wx) * (1 - wy) + at(y0, x1) * wx * (1 - wy)
+               + at(y1, x0) * (1 - wx) * wy + at(y1, x1) * wx * wy)
+        return out
+
+    return jax.vmap(sample_one)(data, sx, sy)

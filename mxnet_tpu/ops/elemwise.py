@@ -1,0 +1,297 @@
+"""Elementwise unary/binary/scalar ops.
+
+Ref: src/operator/tensor/elemwise_*.cc families. On TPU these all lower to
+XLA elementwise HLO and fuse into neighbouring matmuls/reductions for free,
+replacing the reference's NVRTC pointwise-fusion pass.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    return _export(fn)
+
+
+# --- binary broadcast (ref: elemwise_binary_broadcast_op_basic.cc) ---------
+
+@_reg
+def broadcast_add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@_reg
+def broadcast_sub(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@_reg
+def broadcast_mul(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@_reg
+def broadcast_div(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@_reg
+def broadcast_mod(lhs, rhs):
+    return jnp.mod(lhs, rhs)
+
+
+@_reg
+def broadcast_power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@_reg
+def broadcast_maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@_reg
+def broadcast_minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@_reg
+def broadcast_hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@_reg
+def broadcast_equal(lhs, rhs):
+    return (lhs == rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_not_equal(lhs, rhs):
+    return (lhs != rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_greater(lhs, rhs):
+    return (lhs > rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_greater_equal(lhs, rhs):
+    return (lhs >= rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_lesser(lhs, rhs):
+    return (lhs < rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_lesser_equal(lhs, rhs):
+    return (lhs <= rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_logical_and(lhs, rhs):
+    return jnp.logical_and(lhs, rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_logical_or(lhs, rhs):
+    return jnp.logical_or(lhs, rhs).astype(jnp.result_type(lhs))
+
+
+@_reg
+def broadcast_logical_xor(lhs, rhs):
+    return jnp.logical_xor(lhs, rhs).astype(jnp.result_type(lhs))
+
+
+# aliases matching the non-broadcast elemwise names
+@_reg
+def elemwise_add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@_reg
+def elemwise_sub(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@_reg
+def elemwise_mul(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@_reg
+def elemwise_div(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+# --- unary math (ref: elemwise_unary_op_basic.cc, _trig.cc, _pow.cc, _logexp.cc)
+
+_UNARY = {
+    'abs': jnp.abs, 'sign': jnp.sign, 'rint': jnp.rint, 'ceil': jnp.ceil,
+    'floor': jnp.floor, 'trunc': jnp.trunc, 'fix': jnp.trunc,
+    'square': jnp.square, 'sqrt': jnp.sqrt, 'cbrt': jnp.cbrt,
+    'exp': jnp.exp, 'log': jnp.log, 'log10': jnp.log10, 'log2': jnp.log2,
+    'log1p': jnp.log1p, 'expm1': jnp.expm1,
+    'sin': jnp.sin, 'cos': jnp.cos, 'tan': jnp.tan,
+    'arcsin': jnp.arcsin, 'arccos': jnp.arccos, 'arctan': jnp.arctan,
+    'sinh': jnp.sinh, 'cosh': jnp.cosh, 'tanh': jnp.tanh,
+    'arcsinh': jnp.arcsinh, 'arccosh': jnp.arccosh, 'arctanh': jnp.arctanh,
+    'degrees': jnp.degrees, 'radians': jnp.radians,
+    'erf': jax.scipy.special.erf, 'erfinv': jax.scipy.special.erfinv,
+    'gamma': lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    'gammaln': jax.scipy.special.gammaln,
+    'logical_not': lambda x: jnp.logical_not(x).astype(jnp.result_type(x)),
+}
+
+for _name, _jfn in _UNARY.items():
+    def _mk(jfn):
+        def op(data):
+            return jfn(data)
+        return op
+    _f = _mk(_jfn)
+    _f.__name__ = _name
+    globals()[_name] = _f
+    register_op(_name)(_f)
+    __all__.append(_name)
+
+
+@_reg
+def reciprocal(data):
+    return 1.0 / data
+
+
+@_reg
+def rsqrt(data):
+    return jax.lax.rsqrt(data)
+
+
+@_reg
+def rcbrt(data):
+    return 1.0 / jnp.cbrt(data)
+
+
+@_reg
+def negative(data):
+    return jnp.negative(data)
+
+
+@_reg
+def relu(data):
+    return jnp.maximum(data, 0)
+
+
+@_reg
+def sigmoid(data):
+    return jax.nn.sigmoid(data)
+
+
+@_reg
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@_reg
+def softsign(data):
+    return data / (1.0 + jnp.abs(data))
+
+
+@_reg
+def gelu(data):
+    return jax.nn.gelu(data, approximate=False)
+
+
+@_reg
+def gelu_tanh(data):
+    return jax.nn.gelu(data, approximate=True)
+
+
+@_reg
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+# --- scalar ops (ref: elemwise_binary_scalar_op_basic.cc) ------------------
+
+def _scalar(name, fn):
+    def op(data, scalar=1.0):
+        return fn(data, scalar)
+    op.__name__ = name
+    register_op(name)(op)
+    globals()[name] = op
+    __all__.append(name)
+
+
+_scalar('plus_scalar', lambda x, s: x + s)
+_scalar('minus_scalar', lambda x, s: x - s)
+_scalar('rminus_scalar', lambda x, s: s - x)
+_scalar('mul_scalar', lambda x, s: x * s)
+_scalar('div_scalar', lambda x, s: x / s)
+_scalar('rdiv_scalar', lambda x, s: s / x)
+_scalar('mod_scalar', lambda x, s: jnp.mod(x, s))
+_scalar('rmod_scalar', lambda x, s: jnp.mod(s, x))
+_scalar('power_scalar', lambda x, s: jnp.power(x, s))
+_scalar('rpower_scalar', lambda x, s: jnp.power(s, x))
+_scalar('maximum_scalar', lambda x, s: jnp.maximum(x, s))
+_scalar('minimum_scalar', lambda x, s: jnp.minimum(x, s))
+_scalar('equal_scalar', lambda x, s: (x == s).astype(jnp.result_type(x)))
+_scalar('not_equal_scalar', lambda x, s: (x != s).astype(jnp.result_type(x)))
+_scalar('greater_scalar', lambda x, s: (x > s).astype(jnp.result_type(x)))
+_scalar('greater_equal_scalar', lambda x, s: (x >= s).astype(jnp.result_type(x)))
+_scalar('lesser_scalar', lambda x, s: (x < s).astype(jnp.result_type(x)))
+_scalar('lesser_equal_scalar', lambda x, s: (x <= s).astype(jnp.result_type(x)))
+_scalar('logical_and_scalar', lambda x, s: jnp.logical_and(x, s).astype(jnp.result_type(x)))
+_scalar('logical_or_scalar', lambda x, s: jnp.logical_or(x, s).astype(jnp.result_type(x)))
+_scalar('logical_xor_scalar', lambda x, s: jnp.logical_xor(x, s).astype(jnp.result_type(x)))
+
+
+@_reg
+def add_n(*args):
+    """Sum of N arrays (ref: src/ndarray/ndarray_function.h ElementwiseSum)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@_reg
+def cast(data, dtype='float32'):
+    return data.astype(jnp.dtype(dtype))
+
+
+@_reg
+def amp_cast(data, dtype='float16'):
+    """AMP cast (ref: src/operator/tensor/amp_cast.cc); bf16 is the TPU native."""
+    return data.astype(jnp.dtype(dtype))
+
+
+@_reg
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@_reg
+def isnan(data):
+    return jnp.isnan(data).astype(jnp.result_type(data))
+
+
+@_reg
+def isinf(data):
+    return jnp.isinf(data).astype(jnp.result_type(data))
+
+
+@_reg
+def isfinite(data):
+    return jnp.isfinite(data).astype(jnp.result_type(data))

@@ -1,0 +1,111 @@
+"""Indexing ops: take/gather/scatter/boolean_mask/where-family.
+
+Ref: src/operator/tensor/indexing_op.cc, src/operator/contrib/{boolean_mask,
+index_copy}.cc. All map to XLA gather/scatter which stay on-device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def take(a, indices, axis=0, mode='clip'):
+    idx = indices.astype(jnp.int32)
+    jmode = {'clip': 'clip', 'wrap': 'wrap', 'raise': 'clip'}[mode]
+    return jnp.take(a, idx, axis=axis, mode=jmode)
+
+
+@_reg
+def batch_take(a, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[..., None] if idx.ndim < a.ndim else idx,
+                               axis=-1).squeeze(-1)
+
+
+@_reg
+def pick(data, index, axis=-1, keepdims=False, mode='clip'):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis % data.ndim),
+                              axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis % data.ndim)
+    return out
+
+
+@_reg
+def gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@_reg
+def scatter_nd(data, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@_reg
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype(jnp.int32)
+    return old_tensor.at[idx].set(new_tensor)
+
+
+@_reg
+def index_add(data, indices, values):
+    idx = indices.astype(jnp.int32)
+    return data.at[idx].add(values)
+
+
+@_reg
+def boolean_mask(data, index, axis=0):
+    """Ref: src/operator/contrib/boolean_mask.cc. NOTE: output shape is
+    data-dependent; on TPU we return a dense result where unselected rows are
+    compacted to the front and the caller can use `sum(index)` for the count
+    (XLA needs static shapes). Eager mode (outside jit) returns the exact
+    dynamic result."""
+    mask = index.astype(bool)
+    if isinstance(data, jax.core.Tracer) or isinstance(index, jax.core.Tracer):
+        order = jnp.argsort(~mask, stable=True)
+        return jnp.take(data, order, axis=axis)
+    import numpy as onp
+    sel = onp.nonzero(onp.asarray(mask))[0]
+    return jnp.take(data, jnp.asarray(sel), axis=axis)
+
+
+@_reg
+def sequence_mask_like(data, mask):
+    return data * mask
+
+
+@_reg
+def ravel_multi_index(data, shape=None):
+    idx = data.astype(jnp.int64)
+    out = jnp.zeros(idx.shape[1:], dtype=jnp.int64)
+    for i, s in enumerate(shape):
+        out = out * s + idx[i]
+    return out.astype(jnp.float32)
+
+
+@_reg
+def unravel_index(data, shape=None):
+    idx = data.astype(jnp.int64)
+    coords = []
+    rem = idx
+    for s in reversed(shape):
+        coords.append(rem % s)
+        rem = rem // s
+    return jnp.stack(list(reversed(coords)), axis=0).astype(jnp.float32)

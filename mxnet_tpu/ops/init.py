@@ -1,0 +1,48 @@
+"""Creation ops (ref: src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__, nograd=True)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def zeros(shape=(), dtype='float32'):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@_reg
+def ones(shape=(), dtype='float32'):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@_reg
+def full(shape=(), val=0.0, dtype='float32'):
+    return jnp.full(shape, val, dtype=jnp.dtype(dtype))
+
+
+@_reg
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype='float32'):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@_reg
+def linspace(start=0, stop=1, num=50, endpoint=True, dtype='float32'):
+    return jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=jnp.dtype(dtype))
+
+
+@_reg
+def eye(N=0, M=0, k=0, dtype='float32'):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=jnp.dtype(dtype))

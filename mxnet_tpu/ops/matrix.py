@@ -1,0 +1,410 @@
+"""Shape manipulation, matmul, linalg, ordering ops.
+
+Ref: src/operator/tensor/{matrix_op.cc,dot.cc,la_op.cc,ordering_op.cc}.
+Matmuls are kept as single large `dot_general`s so XLA tiles them onto the
+MXU; reshape/transpose are metadata-only for XLA.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op, MXNetError
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def reshape(data, shape=None, reverse=False):
+    """MXNet reshape with special codes 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split) (ref: matrix_op.cc Reshape)."""
+    if shape is None:
+        raise MXNetError("reshape needs a target shape")
+    shape = tuple(int(s) for s in shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(data, shape)
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(reversed(shape))
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@_reg
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@_reg
+def transpose(data, axes=None):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(data, axes)
+
+
+@_reg
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@_reg
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@_reg
+def swapaxes(data, dim1=0, dim2=1):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@_reg
+def slice(data, begin=None, end=None, step=None):
+    """General strided slice (ref: matrix_op.cc Slice); None entries mean full range."""
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step or []) + [None] * (ndim - len(step or []))
+    idx = tuple(builtins_slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+builtins_slice = builtins.slice
+
+
+@_reg
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [builtins_slice(None)] * data.ndim
+    idx[axis] = builtins_slice(begin, end)
+    return data[tuple(idx)]
+
+
+@_reg
+def slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [builtins_slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = builtins_slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@_reg
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@_reg
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+def split(data, num_outputs=None, axis=1, squeeze_axis=False):
+    """Ref: slice_channel.cc (SliceChannel)."""
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+register_op("split", num_outputs=-1)(split)
+__all__.append("split")
+
+
+@_reg
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@_reg
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@_reg
+def flip(data, axis=()):
+    return jnp.flip(data, axis=axis)
+
+
+@_reg
+def reverse(data, axis=()):
+    return jnp.flip(data, axis=axis)
+
+
+@_reg
+def pad(data, mode='constant', pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {'constant': 'constant', 'edge': 'edge', 'reflect': 'reflect'}[mode]
+    if jmode == 'constant':
+        return jnp.pad(data, pw, mode='constant', constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@_reg
+def depth_to_space(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@_reg
+def space_to_depth(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# --- matmul family ---------------------------------------------------------
+
+@_reg
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts last axis of lhs with first axis of rhs
+    (ref: src/operator/tensor/dot.cc)."""
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@_reg
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul over leading dims (ref: dot.cc batch_dot); lowers to one
+    dot_general so the MXU sees a single large batched contraction."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@_reg
+def khatri_rao(*args):
+    """Column-wise Khatri-Rao product (ref: src/operator/contrib/krprod.cc)."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum('ik,jk->ijk', out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# --- linalg (ref: src/operator/tensor/la_op.cc) ----------------------------
+
+@_reg
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@_reg
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@_reg
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@_reg
+def linalg_potri(A):
+    L = jnp.linalg.cholesky(A)
+    inv_l = jax.scipy.linalg.solve_triangular(
+        L, jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape), lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@_reg
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(B, -1, -2), lower=not low)
+        x = jnp.swapaxes(x, -1, -2)
+    else:
+        x = jax.scipy.linalg.solve_triangular(a, B, lower=low)
+    return alpha * x
+
+
+@_reg
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@_reg
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@_reg
+def linalg_sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@_reg
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@_reg
+def linalg_makediag(A, offset=0):
+    return jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature='(n)->(m,m)')(A)
+
+
+@_reg
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@_reg
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@_reg
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+# --- ordering (ref: src/operator/tensor/ordering_op.cc) --------------------
+
+@_reg
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@_reg
+def argsort(data, axis=-1, is_ascend=True, dtype='float32'):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+def topk(data, axis=-1, k=1, ret_typ='indices', is_ascend=False, dtype='float32'):
+    """Ref: ordering_op.cc TopK. ret_typ in {value, indices, mask, both}."""
+    src = -data if is_ascend else data
+    if axis != -1 and axis != data.ndim - 1:
+        src_m = jnp.moveaxis(src, axis, -1)
+    else:
+        src_m = src
+        axis = data.ndim - 1
+    vals, idxs = jax.lax.top_k(src_m, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == 'value':
+        return vals
+    if ret_typ == 'indices':
+        return idxs.astype(jnp.dtype(dtype))
+    if ret_typ == 'mask':
+        mask = jnp.zeros_like(jnp.moveaxis(data, axis, -1))
+        mask = mask.at[..., :].set(0)
+        one_hot = jax.nn.one_hot(jnp.moveaxis(idxs, axis, -1), data.shape[axis],
+                                 dtype=data.dtype).sum(axis=-2)
+        return jnp.moveaxis(one_hot, -1, axis)
+    return vals, idxs.astype(jnp.dtype(dtype))
+
+
+register_op("topk", num_outputs=-1)(topk)
+__all__.append("topk")
+
+
+@_reg
+def shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64)
+
+
+@_reg
+def size_array(data):
+    return jnp.array([data.size], dtype=jnp.int64)
+
+
+@_reg
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@_reg
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@_reg
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@_reg
+def tril(data, k=0):
+    return jnp.tril(data, k)
+
+
+@_reg
+def triu(data, k=0):
+    return jnp.triu(data, k)
+
+
+@_reg
+def einsum(*args, subscripts=''):
+    return jnp.einsum(subscripts, *args)
+
+
+@_reg
+def histogram(data, bin_cnt=10, range=None):
+    hist, edges = jnp.histogram(data, bins=bin_cnt, range=range)
+    return hist, edges

@@ -1,0 +1,234 @@
+"""Optimizer update ops.
+
+Ref: src/operator/optimizer_op.cc (+ contrib/adamw.cc, multi_lamb.cc). In the
+reference, updates are ops inside the engine graph; here they are pure
+functions fused by XLA into the compiled train step — the whole update for a
+parameter is one fused HBM pass.
+
+All take/return jax arrays; multi-precision (mp_*) variants carry an fp32
+master copy of bf16/fp16 weights.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _grad_prep(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight.astype(jnp.float32)
+    return g
+
+
+@_reg
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@_reg
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
+
+
+@_reg
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@_reg
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@_reg
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    new_w = weight.astype(jnp.float32) - lr * (g + momentum * new_mom)
+    return new_w.astype(weight.dtype), new_mom
+
+
+@_reg
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+@_reg
+def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001, eta=1.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, clip_gradient=-1.0):
+    """Ref: src/operator/contrib/adamw.cc — decoupled weight decay."""
+    g = _grad_prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight.astype(jnp.float32)
+    new_w = w32 - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * lr * w32)
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+@_reg
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * w32
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, 0.0,
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@_reg
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n
+
+
+@_reg
+def rmspropalex_update(weight, grad, n, g_acc, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_acc
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight.astype(jnp.float32) + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+@_reg
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    new_w = w32 - lr * (jnp.sign(g) + wd * w32)
+    return new_w.astype(weight.dtype)
+
+
+@_reg
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w32 = weight.astype(jnp.float32)
+    new_w = (1 - lr * wd_lh) * w32 + lr * jnp.sign(new_mom)
+    return new_w.astype(weight.dtype), new_mom
+
+
+@_reg
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Ref: src/operator/optimizer_op.cc lamb_update_phase1."""
+    g = _grad_prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_hat, v_hat = new_mean, new_var
+    if bias_correction:
+        m_hat = new_mean / (1 - beta1 ** t)
+        v_hat = new_var / (1 - beta2 ** t)
+    w32 = weight.astype(jnp.float32)
+    update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w32
+    return update, new_mean, new_var
+
+
+@_reg
+def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    r1v = r1
+    r2v = r2
+    if lower_bound is not None and lower_bound > 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    new_w = weight.astype(jnp.float32) - lr * ratio * g_update
+    return new_w.astype(weight.dtype)
+
+
+@_reg
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_hist = history + jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_hist) + epsilon)
+    return new_w.astype(weight.dtype), new_hist
+
+
+@_reg
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    new_w = weight.astype(jnp.float32) - delta
+    return new_w.astype(weight.dtype), new_acc_g, new_acc_delta
+
+
+@_reg
+def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = _grad_prep(grad, rescale_grad, clip_grad, wd, weight)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight.astype(jnp.float32)
+    new_d = d_t
+    new_w = -new_z / new_d
+    return new_w.astype(weight.dtype), new_d, new_v, new_z
+
+
+@_reg
+def multi_sum_sq(*arrays):
+    """Ref: src/operator/contrib/multi_sum_sq.cc — per-array sum of squares."""
+    return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays)
+
+
+@_reg
+def all_finite(*arrays):
+    """Ref: src/operator/contrib/all_finite.cc — 1.0 if every element finite."""
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok.astype(jnp.float32)

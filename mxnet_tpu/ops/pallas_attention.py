@@ -1,0 +1,72 @@
+"""Pallas flash-attention kernel for TPU (placeholder-free entry point).
+
+The fused MHA op (ops/attention.py multi_head_attention) routes here for
+long sequences on TPU. `flash_attention` currently delegates to a
+blockwise-XLA implementation with online softmax (same memory behavior as
+flash attention: no T×T materialisation in HBM thanks to XLA fusion over
+the scan); a hand-written Pallas kernel drops in behind the same signature.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pallas_available() -> bool:
+    try:
+        return any(d.platform not in ('cpu',) for d in jax.devices())
+    except Exception:
+        return False
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'block_k'))
+def flash_attention(q, k, v, causal=False, block_k=512):
+    """q/k/v: (B, H, T, D). Blockwise attention with online softmax — scans
+    over K/V blocks so the T×T score matrix never hits HBM."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = 1.0 / math.sqrt(D)
+    block_k = min(block_k, Tk)
+    nblocks = (Tk + block_k - 1) // block_k
+    pad = nblocks * block_k - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+
+    q32 = q.astype(jnp.bfloat16) if q.dtype == jnp.bfloat16 else q
+
+    def body(carry, kv):
+        acc, m_prev, l_prev, blk = carry
+        k_cur, v_cur = kv
+        scores = jnp.einsum('bhqd,bhkd->bhqk', q32, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        k_pos = blk * block_k + jnp.arange(block_k)
+        valid = k_pos < Tk
+        if causal:
+            q_pos = jnp.arange(Tq)
+            cmask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(cmask & valid[None, :], scores, -1e30)
+        else:
+            scores = jnp.where(valid[None, :], scores, -1e30)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)
+        acc = acc * alpha + jnp.einsum('bhqk,bhkd->bhqd',
+                                       p.astype(v_cur.dtype), v_cur)
+        l_new = l_prev * alpha + l_cur
+        return (acc, m_new, l_new, blk + 1), None
+
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    (acc, m, l, _), _ = lax.scan(body, (acc0, m0, l0, 0), (kb, vb))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)

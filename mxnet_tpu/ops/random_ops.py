@@ -1,0 +1,130 @@
+"""Random sampling ops (ref: src/operator/random/sample_op.cc et al).
+
+Keys come from mxnet_tpu.random's provider stack (global stateful stream in
+eager mode, functional split stream under tracing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+from .. import random as _random
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__, nograd=True)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def random_uniform(low=0.0, high=1.0, shape=(), dtype='float32'):
+    key = _random.next_key()
+    return jax.random.uniform(key, tuple(shape), dtype=jnp.dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@_reg
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype='float32'):
+    key = _random.next_key()
+    return loc + scale * jax.random.normal(key, tuple(shape),
+                                           dtype=jnp.dtype(dtype))
+
+
+@_reg
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype='float32'):
+    key = _random.next_key()
+    return beta * jax.random.gamma(key, alpha, tuple(shape),
+                                   dtype=jnp.dtype(dtype))
+
+
+@_reg
+def random_exponential(lam=1.0, shape=(), dtype='float32'):
+    key = _random.next_key()
+    return jax.random.exponential(key, tuple(shape),
+                                  dtype=jnp.dtype(dtype)) / lam
+
+
+@_reg
+def random_poisson(lam=1.0, shape=(), dtype='float32'):
+    key = _random.next_key()
+    return jax.random.poisson(key, lam, tuple(shape)).astype(jnp.dtype(dtype))
+
+
+@_reg
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype='float32'):
+    key1, key2 = jax.random.split(_random.next_key())
+    g = jax.random.gamma(key1, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(key2, g).astype(jnp.dtype(dtype))
+
+
+@_reg
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype='float32'):
+    key1, key2 = jax.random.split(_random.next_key())
+    g = jax.random.gamma(key1, 1.0 / alpha, tuple(shape)) * (alpha * mu)
+    return jax.random.poisson(key2, g).astype(jnp.dtype(dtype))
+
+
+@_reg
+def random_randint(low=0, high=1, shape=(), dtype='int32'):
+    key = _random.next_key()
+    return jax.random.randint(key, tuple(shape), low, high,
+                              dtype=jnp.dtype(dtype))
+
+
+@_reg
+def sample_multinomial(data, shape=(), get_prob=False, dtype='int32'):
+    """Ref: src/operator/random/multisample_op.cc. data: (..., K) probabilities."""
+    key = _random.next_key()
+    n = 1
+    for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+        n *= int(s) if s else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    out_shape = data.shape[:-1] + (tuple(shape) if isinstance(shape, (tuple, list)) else (shape,) if shape else ())
+    if not shape:
+        samp = jax.random.categorical(key, logits, axis=-1)
+        return samp.astype(jnp.dtype(dtype))
+    samp = jax.random.categorical(key, logits[..., None, :], axis=-1,
+                                  shape=data.shape[:-1] + (n,))
+    return samp.reshape(out_shape).astype(jnp.dtype(dtype))
+
+
+@_reg
+def shuffle(data):
+    key = _random.next_key()
+    return jax.random.permutation(key, data, axis=0)
+
+
+@_reg
+def sample_uniform(low, high, shape=(), dtype='float32'):
+    """Per-element distribution params (ref: src/operator/random/sample_op.cc)."""
+    key = _random.next_key()
+    sshape = low.shape + tuple(shape)
+    u = jax.random.uniform(key, sshape, dtype=jnp.dtype(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(tuple(shape)))
+    high_b = high.reshape(high.shape + (1,) * len(tuple(shape)))
+    return low_b + u * (high_b - low_b)
+
+
+@_reg
+def sample_normal(mu, sigma, shape=(), dtype='float32'):
+    key = _random.next_key()
+    sshape = mu.shape + tuple(shape)
+    z = jax.random.normal(key, sshape, dtype=jnp.dtype(dtype))
+    mu_b = mu.reshape(mu.shape + (1,) * len(tuple(shape)))
+    sig_b = sigma.reshape(sigma.shape + (1,) * len(tuple(shape)))
+    return mu_b + z * sig_b
+
+
+@_reg
+def sample_gamma(alpha, beta, shape=(), dtype='float32'):
+    key = _random.next_key()
+    sshape = alpha.shape + tuple(shape)
+    a_b = alpha.reshape(alpha.shape + (1,) * len(tuple(shape)))
+    b_b = beta.reshape(beta.shape + (1,) * len(tuple(shape)))
+    g = jax.random.gamma(key, jnp.broadcast_to(a_b, sshape),
+                         dtype=jnp.dtype(dtype))
+    return g * b_b

@@ -1,0 +1,128 @@
+"""Reduce and broadcast ops (ref: src/operator/tensor/broadcast_reduce_op.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, data, axis, keepdims, exclude=False):
+    axis = _norm_axis(axis)
+    if exclude and axis is not None:
+        if isinstance(axis, int):
+            axis = (axis,)
+        axis = tuple(i for i in range(data.ndim) if i not in
+                     tuple(a % data.ndim for a in axis))
+    return jfn(data, axis=axis, keepdims=keepdims)
+
+
+@_reg
+def sum(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.sum, data, axis, keepdims, exclude)
+
+
+@_reg
+def mean(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.mean, data, axis, keepdims, exclude)
+
+
+@_reg
+def prod(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.prod, data, axis, keepdims, exclude)
+
+
+@_reg
+def nansum(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.nansum, data, axis, keepdims, exclude)
+
+
+@_reg
+def nanprod(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.nanprod, data, axis, keepdims, exclude)
+
+
+@_reg
+def max(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.max, data, axis, keepdims, exclude)
+
+
+@_reg
+def min(data, axis=None, keepdims=False, exclude=False):
+    return _reduce(jnp.min, data, axis, keepdims, exclude)
+
+
+@_reg
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@_reg
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@_reg
+def norm(data, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@_reg
+def broadcast_to(data, shape=None):
+    shape = tuple(int(s) if int(s) != 0 else data.shape[i]
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@_reg
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@_reg
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = int(s)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@_reg
+def cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+@_reg
+def cumprod(a, axis=None, dtype=None):
+    return jnp.cumprod(a, axis=axis, dtype=dtype)
+
+
+@_reg
+def moments(data, axes=None, keepdims=False):
+    """Mean and variance in one pass (ref: src/operator/nn/moments.cc)."""
+    axes = _norm_axis(axes)
+    mean_ = jnp.mean(data, axis=axes, keepdims=keepdims)
+    var_ = jnp.var(data, axis=axes, keepdims=keepdims)
+    return mean_, var_

@@ -1,0 +1,65 @@
+"""Sequence ops (ref: src/operator/sequence_{mask,last,reverse}.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """data is (T, N, ...) for axis=0 or (N, T, ...) for axis=1."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    if axis == 0:
+        shape = (T,) + (1,) * (data.ndim - 1)
+        lshape = (1, -1) + (1,) * (data.ndim - 2)
+    else:
+        shape = (1, T) + (1,) * (data.ndim - 2)
+        lshape = (-1, 1) + (1,) * (data.ndim - 2)
+    mask = pos.reshape(shape) < sequence_length.reshape(lshape)
+    return jnp.where(mask, data, value)
+
+
+@_reg
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    if axis == 0:
+        moved = jnp.moveaxis(data, 0, 1)  # (N, T, ...)
+    else:
+        moved = data
+    expand = idx.reshape((-1,) + (1,) * (moved.ndim - 1))
+    out = jnp.take_along_axis(moved, expand.astype(jnp.int32), axis=1)
+    return jnp.squeeze(out, axis=1)
+
+
+@_reg
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    T = data.shape[axis]
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    pos = jnp.arange(T)
+    if axis != 0:
+        data = jnp.moveaxis(data, axis, 0)
+    # per-sequence reversal of the first L entries, rest unchanged
+    L = sequence_length.astype(jnp.int32)  # (N,)
+    rev_idx = jnp.where(pos[:, None] < L[None, :], L[None, :] - 1 - pos[:, None],
+                        pos[:, None])  # (T, N)
+    expand = rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2))
+    out = jnp.take_along_axis(data, jnp.broadcast_to(expand, data.shape), axis=0)
+    if axis != 0:
+        out = jnp.moveaxis(out, 0, axis)
+    return out

@@ -1,0 +1,9 @@
+from .optimizer import (Optimizer, Updater, get_updater, create, register,
+                        SGD, Signum, FTML, LARS, LAMB, NAG, SGLD, Adam, AdamW,
+                        AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam, DCASGD,
+                        Test)
+
+__all__ = ['Optimizer', 'Updater', 'get_updater', 'create', 'register', 'SGD',
+           'Signum', 'FTML', 'LARS', 'LAMB', 'NAG', 'SGLD', 'Adam', 'AdamW',
+           'AdaGrad', 'RMSProp', 'AdaDelta', 'Ftrl', 'Adamax', 'Nadam',
+           'DCASGD', 'Test']

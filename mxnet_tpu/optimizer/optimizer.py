@@ -1,0 +1,722 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py).
+
+Each optimizer's math lives in mxnet_tpu.ops.optimizer_ops as pure jax
+functions (the reference's optimizer_op.cc kernels); here we keep the
+stateful Optimizer API: registry, per-param lr/wd multipliers, update
+counts, multi-precision master weights, and the Updater used by
+kvstore/Trainer.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as onp
+
+from ..base import Registry, MXNetError
+from ..ndarray.ndarray import NDArray, _invoke, zeros as nd_zeros
+from ..ops import optimizer_ops as O
+
+_REG = Registry('optimizer')
+
+
+def register(klass):
+    _REG.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    return _REG.create(name, **kwargs)
+
+
+class Optimizer:
+    """Base optimizer (ref: optimizer.py:52)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = 0
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.param_dict = param_dict if param_dict else {}
+
+    create_optimizer = staticmethod(create)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights (ref: optimizer.py
+        create_state_multi_precision)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (onp.float16, onp.dtype('bfloat16')
+                                                     if hasattr(onp, 'dtype') else None):
+            weight_master_copy = weight.astype('float32')
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        if str(weight.dtype) in ('float16', 'bfloat16') and self.multi_precision:
+            weight_master_copy = weight.astype('float32')
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and str(weight.dtype) in ('float16', 'bfloat16'):
+            master, base_state = state
+            grad32 = grad.astype('float32')
+            self.update(index, master, grad32, base_state)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith('_weight')
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        lr = self.learning_rate
+        lrs = []
+        for index in indices:
+            if index in self.param_dict:
+                lrs.append(lr * self.param_dict[index].lr_mult)
+            elif index in self.lr_mult:
+                lrs.append(lr * self.lr_mult[index])
+            elif index in self.idx2name:
+                lrs.append(lr * self.lr_mult.get(self.idx2name[index], 1.0))
+            else:
+                lrs.append(lr)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = []
+        for index in indices:
+            if index in self.param_dict:
+                wds.append(self.wd * self.param_dict[index].wd_mult)
+            elif index in self.wd_mult:
+                wds.append(self.wd * self.wd_mult[index])
+            elif index in self.idx2name:
+                wds.append(self.wd * self.wd_mult.get(self.idx2name[index], 1.0))
+            else:
+                wds.append(self.wd)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+def _cg(v):
+    return -1.0 if v is None else v
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision (ref: optimizer.py:526)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, dtype='float32')
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            new_w, new_mom = _invoke(
+                O.sgd_mom_update, weight, grad, state, lr=lr,
+                momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = _invoke(O.sgd_update, weight, grad, lr=lr, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+
+
+@register
+class Signum(Optimizer):
+    """Ref: optimizer.py:672."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, dtype='float32')
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            new_w, new_mom = _invoke(
+                O.signum_update, weight, grad, state, lr=lr,
+                momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient), wd_lh=self.wd_lh)
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = _invoke(O.signsgd_update, weight, grad, lr=lr, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, nd_, nv, nz = _invoke(
+            O.ftml_update, weight, grad, d, v, z, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, t=t, wd=wd,
+            rescale_grad=self.rescale_grad, clip_grad=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        d._data, v._data, z._data = nd_._data, nv._data, nz._data
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (ref: optimizer.py:797)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, dtype='float32')
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        w_norm = float(weight.norm().asscalar())
+        g_norm = float((grad * self.rescale_grad).norm().asscalar())
+        if w_norm > 0 and g_norm > 0:
+            lr = lr * self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
+        if state is not None:
+            new_w, new_mom = _invoke(
+                O.sgd_mom_update, weight, grad, state, lr=lr,
+                momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = _invoke(O.sgd_update, weight, grad, lr=lr, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adaptive Moments for Batch training (ref: optimizer.py:1250)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g_update, new_mean, new_var = _invoke(
+            O.lamb_update_phase1, weight, grad, mean, var, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=_cg(self.clip_gradient))
+        mean._data, var._data = new_mean._data, new_var._data
+        r1 = weight.astype('float32').norm()
+        r2 = g_update.norm()
+        new_w = _invoke(O.lamb_update_phase2, weight, g_update, r1, r2, lr=lr,
+                        lower_bound=_cg(self.lower_bound),
+                        upper_bound=_cg(self.upper_bound))
+        weight._data = new_w._data
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, dtype='float32')
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            new_w, new_mom = _invoke(
+                O.nag_mom_update, weight, grad, state, lr=lr,
+                momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = _invoke(O.sgd_update, weight, grad, lr=lr, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=_cg(self.clip_gradient))
+            weight._data = new_w._data
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        from ..ndarray import random as nd_random
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd_random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype='float32')
+        weight._data = (weight - lr / 2 * (g + wd * weight) + noise)._data
+
+
+@register
+class Adam(Optimizer):
+    """Ref: optimizer.py:1547."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = _invoke(
+            O.adam_update, weight, grad, mean, var, lr=lr_t, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam (ref: src/operator/contrib/adamw.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.eta = eta
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mean, var = state
+        new_w, new_mean, new_var = _invoke(
+            O.adamw_update, weight, grad, mean, var,
+            rescale_grad=self.rescale_grad, lr=lr, eta=self.eta,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            clip_gradient=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, dtype='float32')
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        new_w, new_hist = _invoke(
+            O.adagrad_update, weight, grad, state, lr=lr,
+            epsilon=self.float_stable_eps, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        state._data = new_hist._data
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd_zeros(weight.shape, dtype='float32'),
+                    nd_zeros(weight.shape, dtype='float32'),
+                    nd_zeros(weight.shape, dtype='float32'))
+        return nd_zeros(weight.shape, dtype='float32')
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if not self.centered:
+            new_w, new_n = _invoke(
+                O.rmsprop_update, weight, grad, state, lr=lr,
+                gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient),
+                clip_weights=_cg(self.clip_weights))
+            weight._data = new_w._data
+            state._data = new_n._data
+        else:
+            n, g, delta = state
+            new_w, nn, ng, ndel = _invoke(
+                O.rmspropalex_update, weight, grad, n, g, delta, lr=lr,
+                gamma1=self.gamma1, gamma2=self.gamma2, epsilon=self.epsilon,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=_cg(self.clip_gradient),
+                clip_weights=_cg(self.clip_weights))
+            weight._data = new_w._data
+            n._data, g._data, delta._data = nn._data, ng._data, ndel._data
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        new_w, ng, ndelta = _invoke(
+            O.adadelta_update, weight, grad, acc_g, acc_delta, rho=self.rho,
+            epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        acc_g._data, acc_delta._data = ng._data, ndelta._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        new_w, nz, nn = _invoke(
+            O.ftrl_update, weight, grad, z, n, lr=lr, lamda1=self.lamda1,
+            beta=self.beta, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=_cg(self.clip_gradient))
+        weight._data = new_w._data
+        z._data, n._data = nz._data, nn._data
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        m, u = state
+        g = (grad * self.rescale_grad).astype('float32')
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.astype('float32')
+        m._data = (self.beta1 * m + (1. - self.beta1) * g)._data
+        import jax.numpy as jnp
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g._data))
+        weight._data = (weight.astype('float32') - lr * m / (u + 1e-8)) \
+            ._data.astype(weight._data.dtype)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, dtype='float32'),
+                nd_zeros(weight.shape, dtype='float32'))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = (grad * self.rescale_grad).astype('float32')
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.astype('float32')
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._data = (self.beta1 * m + (1. - self.beta1) * g)._data
+        v._data = (self.beta2 * v + (1. - self.beta2) * g * g)._data
+        grad_prime = g / (1. - self.m_schedule)
+        m_t_prime = m / (1. - m_schedule_next)
+        v_t_prime = v / (1. - self.beta2 ** t)
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        new_w = (weight.astype('float32')
+                 - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon))
+        weight._data = new_w._data.astype(weight._data.dtype)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd_zeros(weight.shape, dtype='float32'), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = (grad * self.rescale_grad).astype('float32')
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mon, previous_weight = state
+        w32 = weight.astype('float32')
+        delta = (-lr * (g + wd * w32 + self.lamda * g * g
+                        * (w32 - previous_weight)))
+        if mon is not None:
+            mon._data = (self.momentum * mon + delta)._data
+            delta = mon
+        previous_weight._data = weight._data
+        weight._data = (w32 + delta)._data.astype(weight._data.dtype)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, dtype='float32')
+
+    def update(self, index, weight, grad, state):
+        weight._data = (weight + grad * self.rescale_grad)._data
+
+
+class Updater:
+    """Local updater interface (ref: optimizer.py:2070)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def sync_state_context(self, state, context):
+        return state
+
+    def set_states(self, states):
+        from ..ndarray.ndarray import array as nd_array
+        import numpy as onp
+
+        def _ndify(s):
+            if isinstance(s, onp.ndarray):
+                return nd_array(s)
+            if isinstance(s, (list, tuple)):
+                return tuple(_ndify(x) for x in s)
+            return s
+
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2 and \
+                isinstance(states[1], Optimizer):
+            loaded, self.optimizer = states
+        else:
+            loaded = states
+        self.states = {k: _ndify(v) for k, v in loaded.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        def _npify(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (list, tuple)):
+                return tuple(_npify(x) for x in s)
+            return s
+        states = {k: _npify(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

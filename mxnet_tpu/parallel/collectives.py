@@ -1,0 +1,75 @@
+"""Collective-communication surface.
+
+The reference exposes push/pull (ps-lite) and NCCL allreduce; on TPU the
+collectives are XLA ops inside compiled programs. This module provides:
+- axis-name bookkeeping so layers (SyncBatchNorm) know which mesh axis is
+  the data axis while tracing inside shard_map;
+- thin wrappers over lax collectives usable in custom shard_map kernels.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+from jax import lax
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, 'axes'):
+        _tls.axes = []
+    return _tls.axes
+
+
+class data_axis:
+    """Context manager declaring the active data-parallel axis name while
+    tracing inside shard_map/pjit."""
+
+    def __init__(self, name='dp'):
+        self.name = name
+
+    def __enter__(self):
+        _stack().append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+def current_data_axis():
+    s = _stack()
+    return s[-1] if s else None
+
+
+def psum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+def pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name) if hasattr(lax, 'axis_size') else \
+        lax.psum(1, axis_name)

@@ -1,0 +1,76 @@
+"""Multi-process distributed init + launcher.
+
+Ref: tools/launch.py + dmlc tracker (scheduler/server/worker env bootstrap
+via DMLC_ROLE / DMLC_PS_ROOT_URI). TPU-native: `jax.distributed.initialize`
+replaces the tracker; there are no server processes — every process is a
+symmetric worker and collectives ride ICI/DCN.
+
+Env protocol (launch-compatible shape):
+  MXNET_TPU_COORDINATOR  host:port of process 0
+  MXNET_TPU_NUM_PROCS    total processes
+  MXNET_TPU_PROC_ID      this process's rank
+(Also accepts the DMLC_* names for drop-in use of reference launch scripts.)
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import jax
+
+
+_initialized = False
+
+
+def init(coordinator=None, num_processes=None, process_id=None,
+         local_device_ids=None):
+    """Initialize jax.distributed from args or env."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get(
+        'MXNET_TPU_COORDINATOR',
+        _dmlc_coordinator())
+    num_processes = num_processes or int(os.environ.get(
+        'MXNET_TPU_NUM_PROCS', os.environ.get('DMLC_NUM_WORKER', '1')))
+    process_id = process_id if process_id is not None else int(os.environ.get(
+        'MXNET_TPU_PROC_ID', os.environ.get('DMLC_WORKER_ID', '0')))
+    if num_processes <= 1:
+        _initialized = True
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def _dmlc_coordinator():
+    uri = os.environ.get('DMLC_PS_ROOT_URI')
+    port = os.environ.get('DMLC_PS_ROOT_PORT', '9000')
+    if uri:
+        return f"{uri}:{port}"
+    return 'localhost:12345'
+
+
+def rank():
+    return jax.process_index()
+
+
+def num_workers():
+    return jax.process_count()
+
+
+def launch_local(script, n=2, env=None, coordinator='localhost:29500'):
+    """Spawn n local worker processes (the `--launcher local` analog of
+    tools/launch.py). Returns their exit codes."""
+    procs = []
+    for i in range(n):
+        e = dict(os.environ)
+        e.update(env or {})
+        e['MXNET_TPU_COORDINATOR'] = coordinator
+        e['MXNET_TPU_NUM_PROCS'] = str(n)
+        e['MXNET_TPU_PROC_ID'] = str(i)
+        procs.append(subprocess.Popen([sys.executable] + script, env=e))
+    return [p.wait() for p in procs]

@@ -1,0 +1,60 @@
+"""Device-mesh helpers.
+
+The mesh is the TPU analog of the reference's device topology awareness
+(ref: src/kvstore/gpu_topology.h builds reduction trees from PCIe links;
+here ICI topology is expressed as mesh axes and XLA routes collectives).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axis_shapes: Sequence[int] = None,
+              axis_names: Sequence[str] = ('dp',),
+              devices=None) -> Mesh:
+    """Create a Mesh. axis_shapes=None uses all devices on one 'dp' axis."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_shapes is None:
+        axis_shapes = (n,)
+    total = 1
+    for s in axis_shapes:
+        total *= s
+    if total != n:
+        raise ValueError(f"mesh {tuple(axis_shapes)} needs {total} devices, "
+                         f"have {n}")
+    dev_array = onp.array(devices).reshape(tuple(axis_shapes))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def mesh_shape(mesh: Mesh = None):
+    mesh = mesh or default_mesh()
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def data_parallel_spec(mesh: Mesh = None, axis: str = 'dp'):
+    """PartitionSpec sharding the batch dim over the data axis."""
+    return P(axis)
+
+
+def replicate_spec():
+    return P()

@@ -1,0 +1,265 @@
+"""Compiled sharded training step — the performance path.
+
+This is the TPU-native realisation of the north star (BASELINE.json): the
+whole train step (forward + backward + optimizer update + gradient
+all-reduce) is ONE pjit-compiled XLA program per step. Parameters are
+replicated (DP) or sharded (TP via param_specs) over the mesh; the batch is
+sharded over the 'dp' axis; XLA inserts the gradient all-reduce over ICI.
+Buffer donation on params/optimizer state gives the reference's
+static-alloc in-place update behavior (ref: CachedOp static_alloc,
+src/imperative/cached_op.cc:525).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import state as _flags
+from ..ndarray.ndarray import NDArray
+from .. import random as _random
+from .mesh import default_mesh
+
+
+def _sgd_init(p):
+    return (jnp.zeros_like(p),)
+
+
+def _sgd_update(p, g, s, lr, momentum=0.9, wd=0.0):
+    mom, = s
+    g = g + wd * p
+    new_mom = momentum * mom - lr * g
+    return p + new_mom, (new_mom,)
+
+
+def _adam_init(p):
+    return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros((), jnp.int32))
+
+
+def _adam_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.0):
+    m, v, t = s
+    t = t + 1
+    g = g + wd * p
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t.astype(jnp.float32))
+    vhat = v / (1 - beta2 ** t.astype(jnp.float32))
+    return p - lr * mhat / (jnp.sqrt(vhat) + eps), (m, v, t)
+
+
+def _adamw_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01):
+    m, v, t = s
+    t = t + 1
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t.astype(jnp.float32))
+    vhat = v / (1 - beta2 ** t.astype(jnp.float32))
+    return p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p), (m, v, t)
+
+
+def _lamb_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-6, wd=0.01):
+    m, v, t = s
+    t = t + 1
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t.astype(jnp.float32))
+    vhat = v / (1 - beta2 ** t.astype(jnp.float32))
+    update = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    r1 = jnp.linalg.norm(p.reshape(-1))
+    r2 = jnp.linalg.norm(update.reshape(-1))
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return p - lr * ratio * update, (m, v, t)
+
+
+_OPTS = {
+    'sgd': (_sgd_init, _sgd_update),
+    'adam': (_adam_init, _adam_update),
+    'adamw': (_adam_init, _adamw_update),
+    'lamb': (_adam_init, _lamb_update),
+}
+
+
+class ShardedTrainStep:
+    """One-pjit-call training step for a Gluon block over a device mesh.
+
+    Usage:
+        step = ShardedTrainStep(net, loss_fn, 'adam',
+                                optimizer_params={'lr': 1e-3}, mesh=mesh)
+        loss = step(data, label)      # NDArrays; params updated in place
+    """
+
+    def __init__(self, block, loss_fn, optimizer='sgd', optimizer_params=None,
+                 mesh=None, dp_axis='dp', param_specs=None, donate=True,
+                 grad_dtype=None):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.dp_axis = dp_axis
+        self.optimizer_params = dict(optimizer_params or {})
+        self.lr = self.optimizer_params.pop('learning_rate',
+                                            self.optimizer_params.pop('lr', 0.01))
+        if optimizer not in _OPTS:
+            raise ValueError(f"ShardedTrainStep supports {sorted(_OPTS)}")
+        self._opt_init, self._opt_update = _OPTS[optimizer]
+        self.param_specs = param_specs or {}
+        self.donate = donate
+        self._params = None       # list[(name, Parameter)]
+        self._opt_state = None
+        self._compiled = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _collect(self):
+        params = sorted(self.block.collect_params().items())
+        trainable = [(n, p) for n, p in params if p.grad_req != 'null']
+        frozen = [(n, p) for n, p in params if p.grad_req == 'null']
+        return trainable, frozen
+
+    def _spec_for(self, name):
+        for pat, spec in self.param_specs.items():
+            if pat in name:
+                return spec
+        return P()  # replicated
+
+    def _build(self, example_inputs, example_labels):
+        trainable, frozen = self._collect()
+        t_names = [n for n, _ in trainable]
+        f_names = [n for n, _ in frozen]
+        block = self.block
+        loss_fn = self.loss_fn
+        opt_update = self._opt_update
+        opt_kwargs = self.optimizer_params
+        n_inputs = len(example_inputs)
+
+        def forward_loss(t_params, f_params, inputs, labels, key):
+            all_params = dict(t_params)
+            all_params.update(f_params)
+            name_to_param = dict(trainable + frozen)
+            proxies = {}
+            for n, p in name_to_param.items():
+                proxies[n] = NDArray(all_params[n])
+                p._set_trace_proxy(proxies[n])
+            prev = _flags.is_training
+            _flags.is_training = True
+            try:
+                with _random.key_provider(_random.TraceKeyProvider(key)):
+                    out = block.forward(*[NDArray(x) for x in inputs])
+                    outs = out if isinstance(out, (list, tuple)) else (out,)
+                    loss = loss_fn(*outs, *[NDArray(l) for l in labels])
+            finally:
+                _flags.is_training = prev
+                for p in name_to_param.values():
+                    p._clear_trace_proxy()
+            loss_val = jnp.mean(loss._data)
+            aux = {n: proxies[n]._data for n in f_names}
+            return loss_val, aux
+
+        def train_step(t_params, f_params, opt_state, inputs, labels, key, lr):
+            (loss_val, aux), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(t_params, f_params, inputs,
+                                            labels, key)
+            new_params = {}
+            new_state = {}
+            for n in t_names:
+                p32 = t_params[n].astype(jnp.float32)
+                g32 = grads[n].astype(jnp.float32)
+                np_, ns_ = opt_update(p32, g32, opt_state[n], lr, **opt_kwargs)
+                new_params[n] = np_.astype(t_params[n].dtype)
+                new_state[n] = ns_
+            new_f = {n: aux.get(n, f_params[n]) for n in f_names}
+            return new_params, new_f, new_state, loss_val
+
+        # shardings
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, P(self.dp_axis))
+
+        t_shardings = {n: NamedSharding(mesh, self._spec_for(n))
+                       for n in t_names}
+        f_shardings = {n: NamedSharding(mesh, self._spec_for(n))
+                       for n in f_names}
+        # optimizer state shards like its parameter
+        state_shardings = {
+            n: tuple((repl if s.ndim == 0 else t_shardings[n])
+                     for s in self._opt_state[n])
+            for n in t_names}
+
+        in_shardings = (t_shardings, f_shardings, state_shardings,
+                        tuple(batch_sh for _ in example_inputs),
+                        tuple(batch_sh for _ in example_labels),
+                        repl, repl)
+        out_shardings = (t_shardings, f_shardings, state_shardings, repl)
+        donate = (0, 2) if self.donate else ()
+        self._compiled = jax.jit(train_step, in_shardings=in_shardings,
+                                 out_shardings=out_shardings,
+                                 donate_argnums=donate)
+        self._t_names = t_names
+        self._f_names = f_names
+        self._trainable = trainable
+        self._frozen = frozen
+        self._t_shardings = t_shardings
+        self._f_shardings = f_shardings
+        self._batch_sh = batch_sh
+
+    # ------------------------------------------------------------------
+    def init(self, *example_inputs):
+        """Force parameter init (deferred shapes) by one eager forward."""
+        rec = _flags.is_recording
+        _flags.is_recording = False
+        try:
+            self.block(*example_inputs)
+        finally:
+            _flags.is_recording = rec
+
+    def __call__(self, inputs, labels, lr=None):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        in_datas = tuple(x._data if isinstance(x, NDArray) else x
+                         for x in inputs)
+        lab_datas = tuple(x._data if isinstance(x, NDArray) else x
+                          for x in labels)
+        if self._compiled is None:
+            trainable, frozen = self._collect()
+            if not trainable and not frozen:
+                self.init(*inputs)
+                trainable, frozen = self._collect()
+            if any(p._data is None for _, p in trainable + frozen):
+                self.init(*inputs)
+            self._opt_state = {
+                n: self._opt_init(p.data()._data.astype(jnp.float32))
+                for n, p in trainable}
+            self._build(in_datas, lab_datas)
+            # place params on the mesh with their shardings
+            for n, p in self._trainable:
+                p._data[0]._data = jax.device_put(p.data()._data,
+                                                  self._t_shardings[n])
+            for n, p in self._frozen:
+                p._data[0]._data = jax.device_put(p.data()._data,
+                                                  self._f_shardings[n])
+            self._opt_state = jax.device_put(
+                self._opt_state,
+                {n: tuple(NamedSharding(self.mesh, P()) if s.ndim == 0
+                          else self._t_shardings[n]
+                          for s in self._opt_state[n])
+                 for n in self._t_names})
+
+        t_params = {n: p.data()._data for n, p in self._trainable}
+        f_params = {n: p.data()._data for n, p in self._frozen}
+        key = _random.next_key()
+        lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
+        in_datas = tuple(jax.device_put(x, self._batch_sh) for x in in_datas)
+        lab_datas = tuple(jax.device_put(x, self._batch_sh) for x in lab_datas)
+        new_t, new_f, new_state, loss = self._compiled(
+            t_params, f_params, self._opt_state, in_datas, lab_datas, key,
+            lr_val)
+        for n, p in self._trainable:
+            p.data()._data = new_t[n]
+        for n, p in self._frozen:
+            p.data()._data = new_f[n]
+        self._opt_state = new_state
+        self._step_count += 1
+        return NDArray(loss)

@@ -1,0 +1,218 @@
+"""Profiler: scoped tracing with chrome://tracing JSON output.
+
+Ref: src/profiler/profiler.h:79,251-299 and python/mxnet/profiler.py. On TPU
+the heavy lifting is jax.profiler (XLA/TPU traces viewable in TensorBoard or
+Perfetto); this module keeps the reference's API (set_config, start/stop,
+scoped Task/Frame/Event/Counter/Marker) and emits a chrome-tracing JSON of
+python-level scopes, while optionally also capturing a jax device trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+
+from .base import MXNetError
+
+_config = {
+    'filename': 'profile.json',
+    'profile_all': False,
+    'profile_symbolic': False,
+    'profile_imperative': False,
+    'profile_memory': False,
+    'profile_api': False,
+    'aggregate_stats': False,
+    'continuous_dump': False,
+}
+_state = {'running': False, 'jax_trace_dir': None}
+_events = []
+_events_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Ref: python/mxnet/profiler.py set_config."""
+    for k, v in kwargs.items():
+        _config[k] = v
+
+
+def profiler_set_config(mode='symbolic', filename='profile.json'):
+    _config['filename'] = filename
+
+
+def set_state(state='stop', profile_process='worker'):
+    if state == 'run':
+        start()
+    else:
+        stop()
+
+
+def start(profile_process='worker'):
+    _state['running'] = True
+    _events.clear()
+    tdir = os.environ.get('MXNET_TPU_JAX_TRACE_DIR')
+    if tdir:
+        jax.profiler.start_trace(tdir)
+        _state['jax_trace_dir'] = tdir
+
+
+def stop(profile_process='worker'):
+    _state['running'] = False
+    if _state['jax_trace_dir']:
+        jax.profiler.stop_trace()
+        _state['jax_trace_dir'] = None
+
+
+def pause(profile_process='worker'):
+    _state['running'] = False
+
+
+def resume(profile_process='worker'):
+    _state['running'] = True
+
+
+def dump(finished=True, profile_process='worker'):
+    """Write chrome://tracing JSON (ref: profiler.h:79 'chrome tracing')."""
+    with _events_lock:
+        trace = {'traceEvents': list(_events), 'displayTimeUnit': 'ms'}
+    with open(_config['filename'], 'w') as f:
+        json.dump(trace, f)
+
+
+def dumps(reset=False):
+    with _events_lock:
+        out = json.dumps({'traceEvents': list(_events)})
+        if reset:
+            _events.clear()
+    return out
+
+
+def _emit(name, cat, ph, ts=None, args=None, dur=None):
+    ev = {'name': name, 'cat': cat, 'ph': ph,
+          'ts': (ts if ts is not None else time.time() * 1e6),
+          'pid': os.getpid(), 'tid': threading.get_ident()}
+    if args:
+        ev['args'] = args
+    if dur is not None:
+        ev['dur'] = dur
+    with _events_lock:
+        _events.append(ev)
+
+
+class _Scope:
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.time() * 1e6
+        if _state['running']:
+            _emit(self.name, self.cat, 'B', self._t0)
+        return self
+
+    def stop(self):
+        if _state['running']:
+            _emit(self.name, self.cat, 'E')
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class Task(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(name, f'task/{domain.name}')
+
+
+class Frame(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(name, f'frame/{domain.name}')
+
+
+class Event(_Scope):
+    def __init__(self, name):
+        super().__init__(name, 'event')
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = value if value is not None else 0
+        if value is not None:
+            self._record()
+
+    def _record(self):
+        if _state['running']:
+            _emit(self.name, f'counter/{self.domain.name}', 'C',
+                  args={self.name: self.value})
+
+    def set_value(self, value):
+        self.value = value
+        self._record()
+
+    def increment(self, delta=1):
+        self.value += delta
+        self._record()
+
+    def decrement(self, delta=1):
+        self.value -= delta
+        self._record()
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope='process'):
+        if _state['running']:
+            _emit(self.name, f'marker/{self.domain.name}', 'I')
+
+
+def scope(name='<unk>:'):
+    return _Scope(name, 'scope')
+
+
+def annotate(name):
+    """Decorator/context adding a named region to both the python trace and
+    the jax/XLA device trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTraceAnnotation:
+    def __init__(self, step_num):
+        self._ctx = jax.profiler.StepTraceAnnotation("train", step_num=step_num)
+
+    def __enter__(self):
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)

@@ -1,0 +1,93 @@
+"""Random state management.
+
+Ref: src/common/random_generator.{h,cu} and python/mxnet/random.py — the
+reference keeps per-device stateful generators. JAX randomness is functional
+(explicit PRNG keys), so we bridge the two worlds with a *key provider*
+stack:
+
+- eager mode: a process-global counter-based key stream (stateful facade over
+  counter-based splitting — deterministic under `seed()`);
+- traced/compiled mode (CachedOp / hybridize): the compiled step takes an
+  explicit key argument and pushes a functional provider, so RNG ops inside
+  jit draw fresh keys every call instead of baking one in as a constant.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _onp
+
+
+class _KeyProvider:
+    def next_key(self):
+        raise NotImplementedError
+
+
+class _GlobalKeyProvider(_KeyProvider):
+    def __init__(self, seed_val: int = 0):
+        self._lock = threading.Lock()
+        self.seed(seed_val)
+
+    def seed(self, seed_val: int):
+        with self._lock:
+            self._base = jax.random.PRNGKey(seed_val)
+            self._counter = 0
+
+    def next_key(self):
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._base, self._counter)
+
+
+class TraceKeyProvider(_KeyProvider):
+    """Functional provider used while tracing a compiled step: splits a key
+    argument so every RNG op in the graph gets an independent stream."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_global_provider = _GlobalKeyProvider(0)
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, 'stack'):
+        _tls.stack = []
+    return _tls.stack
+
+
+class key_provider:
+    """Context manager installing a key provider (used by CachedOp tracing)."""
+
+    def __init__(self, provider: _KeyProvider):
+        self.provider = provider
+
+    def __enter__(self):
+        _stack().append(self.provider)
+        return self.provider
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+def next_key():
+    stack = _stack()
+    if stack:
+        return stack[-1].next_key()
+    return _global_provider.next_key()
+
+
+def in_traced_rng() -> bool:
+    return bool(_stack())
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (ref: python/mxnet/random.py seed)."""
+    _global_provider.seed(int(seed_state))
+    _onp.random.seed(int(seed_state) % (2 ** 31))

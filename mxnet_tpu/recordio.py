@@ -1,0 +1,187 @@
+"""RecordIO file format: MXRecordIO / MXIndexedRecordIO / pack-unpack.
+
+Ref: python/mxnet/recordio.py and dmlc-core recordio. Binary-compatible with
+the reference format: records framed as [magic u32][lrec u32][data][pad to 4B]
+where lrec encodes cflag (top 3 bits) and length (29 bits); image records
+carry an IRHeader (flag, label, id, id2).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as onp
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+
+IRHeader = collections.namedtuple('HEADER', ['flag', 'label', 'id', 'id2'])
+_IR_FORMAT = 'IfQQ'
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return (lrec >> 29) & 7, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == 'w':
+            self.handle = open(self.uri, 'wb')
+            self.writable = True
+        elif self.flag == 'r':
+            self.handle = open(self.uri, 'rb')
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open and self.handle:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d['handle'] = None
+        d['is_open'] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if not self.is_open:
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        lrec = _encode_lrec(0, len(buf))
+        self.handle.write(struct.pack('<II', _MAGIC, lrec))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b'\x00' * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack('<II', head)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic")
+        _, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec with .idx (ref: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split('\t')
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, 'w') as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a string with IRHeader (ref: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id, header.id2)
+        return hdr + s
+    label = onp.asarray(header.label, dtype=onp.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload) (ref: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], dtype=onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    header, img_bytes = unpack(s)
+    import io as _io
+    from PIL import Image
+    img = onp.asarray(Image.open(_io.BytesIO(img_bytes)))
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt='.jpg'):
+    import io as _io
+    from PIL import Image
+    buf = _io.BytesIO()
+    fmt = 'JPEG' if img_fmt in ('.jpg', '.jpeg') else 'PNG'
+    Image.fromarray(onp.asarray(img)).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())

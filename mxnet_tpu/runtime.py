@@ -1,0 +1,63 @@
+"""Runtime feature detection (ref: python/mxnet/runtime.py, src/libinfo.cc)."""
+from __future__ import annotations
+
+import collections
+
+import jax
+
+
+class Feature(collections.namedtuple('Feature', ['name', 'enabled'])):
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    devices = jax.devices()
+    has_tpu = any(d.platform not in ('cpu',) for d in devices)
+    feats = {
+        'TPU': has_tpu,
+        'CUDA': False,
+        'CUDNN': False,
+        'NCCL': False,
+        'XLA': True,
+        'PALLAS': has_tpu,
+        'CPU': True,
+        'OPENMP': True,
+        'F16C': True,
+        'BF16': True,
+        'BLAS_OPEN': True,
+        'DIST_KVSTORE': True,
+        'INT64_TENSOR_SIZE': True,
+        'SIGNAL_HANDLER': False,
+        'DEBUG': False,
+        'MKLDNN': False,
+        'TENSORRT': False,
+        'TVM_OP': False,
+        'PROFILER': True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+class Features(dict):
+    """Ref: runtime.py Features."""
+
+    instance = None
+
+    def __new__(cls):
+        if cls.instance is None:
+            cls.instance = super().__new__(cls)
+            dict.__init__(cls.instance, _detect())
+        return cls.instance
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

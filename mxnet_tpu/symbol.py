@@ -1,0 +1,398 @@
+"""Symbol: lazy graph construction API (ref: python/mxnet/symbol/symbol.py).
+
+TPU-native design: a Symbol is a lightweight DAG node over the same op
+registry the imperative path uses (there is no separate NNVM graph — the
+"graph compile" is a jax.jit trace of the DAG evaluation, which is exactly
+what CachedOp does for hybridized blocks). `simple_bind` returns an
+Executor whose forward/backward run one compiled XLA executable each
+(ref: src/executor/graph_executor.cc — memory planning, op fusion and
+scheduling are XLA's job here).
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError, _OP_REGISTRY, get_op
+from .context import cpu
+from .ndarray.ndarray import NDArray, array, zeros as nd_zeros, _wrap
+
+
+class Symbol:
+    _counter = [0]
+
+    def __init__(self, op=None, inputs=(), attrs=None, name=None,
+                 num_outputs=1, out_index=0):
+        self.op = op                  # None => variable
+        self.inputs = list(inputs)
+        self.attrs = dict(attrs or {})
+        if name is None:
+            base = op if op else 'var'
+            Symbol._counter[0] += 1
+            name = f"{base}{Symbol._counter[0]}"
+        self._name = name
+        self.num_outputs = num_outputs
+        self.out_index = out_index
+
+    # ---- introspection ----------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def list_arguments(self):
+        seen = []
+        def visit(s):
+            if s.op is None and s._name not in seen:
+                seen.append(s._name)
+            for i in s.inputs:
+                visit(i)
+        visit(self)
+        return seen
+
+    def list_outputs(self):
+        return [self._name + '_output']
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        nodes = []
+        def visit(s):
+            for i in s.inputs:
+                visit(i)
+            if s not in nodes:
+                nodes.append(s)
+        visit(self)
+        return _SymbolList(nodes)
+
+    def attr(self, key):
+        return self.attrs.get(key)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, int):
+            if self.num_outputs == 1:
+                if idx != 0:
+                    raise MXNetError("index out of range")
+                return self
+            return Symbol(self.op, self.inputs, self.attrs, self._name,
+                          self.num_outputs, idx)
+        raise MXNetError("Symbol only supports integer indexing")
+
+    # ---- graph building ---------------------------------------------------
+    def _bin(self, other, opname, scalar_op):
+        if isinstance(other, Symbol):
+            return _apply(opname, [self, other], {})
+        return _apply(scalar_op, [self], {'scalar': other})
+
+    def __add__(self, other):
+        return self._bin(other, 'broadcast_add', 'plus_scalar')
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._bin(other, 'broadcast_sub', 'minus_scalar')
+
+    def __rsub__(self, other):
+        return _apply('rminus_scalar', [self], {'scalar': other})
+
+    def __mul__(self, other):
+        return self._bin(other, 'broadcast_mul', 'mul_scalar')
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._bin(other, 'broadcast_div', 'div_scalar')
+
+    def __rtruediv__(self, other):
+        return _apply('rdiv_scalar', [self], {'scalar': other})
+
+    def __pow__(self, other):
+        return self._bin(other, 'broadcast_power', 'power_scalar')
+
+    def __neg__(self):
+        return _apply('negative', [self], {})
+
+    # ---- evaluation -------------------------------------------------------
+    def eval_dict(self, bindings):
+        """Evaluate eagerly given {name: NDArray}."""
+        cache = {}
+        out = _eval_node(self, {k: (v._data if isinstance(v, NDArray) else v)
+                                for k, v in bindings.items()}, cache)
+        return _wrap(out)
+
+    def eval(self, ctx=None, **kwargs):
+        out = self.eval_dict(kwargs)
+        return [out]
+
+    def infer_shape(self, **shapes):
+        """Shape inference via jax.eval_shape over the DAG."""
+        names = self.list_arguments()
+        specs = {}
+        for n in names:
+            if n in shapes:
+                specs[n] = jax.ShapeDtypeStruct(tuple(shapes[n]), jnp.float32)
+            else:
+                return None, None, None
+        def f(bind):
+            cache = {}
+            return _eval_node(self, bind, cache)
+        out = jax.eval_shape(f, specs)
+        arg_shapes = [tuple(specs[n].shape) for n in names]
+        return arg_shapes, [tuple(out.shape)], []
+
+    def infer_type(self, **types):
+        names = self.list_arguments()
+        return ([onp.float32] * len(names), [onp.float32], [])
+
+    # ---- binding ----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req='write', **shapes):
+        """Ref: symbol.py:1507 simple_bind."""
+        names = self.list_arguments()
+        args = {}
+        for n in names:
+            if n not in shapes:
+                raise MXNetError(f"simple_bind missing shape for {n}")
+            args[n] = nd_zeros(shapes[n], ctx)
+        grads = {n: nd_zeros(shapes[n], ctx) for n in names} \
+            if grad_req != 'null' else {}
+        return Executor(self, args, grads, grad_req, ctx)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req='write',
+             aux_states=None, **kwargs):
+        """Ref: symbol.py:1809 bind."""
+        names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(names, args_grad))
+        return Executor(self, args or {}, args_grad or {}, grad_req, ctx)
+
+    # ---- serialization ----------------------------------------------------
+    def tojson(self):
+        nodes = []
+        index = {}
+
+        def visit(s):
+            if id(s) in index:
+                return index[id(s)]
+            in_idx = [visit(i) for i in s.inputs]
+            idx = len(nodes)
+            nodes.append({'op': s.op or 'null', 'name': s._name,
+                          'attrs': {k: str(v) for k, v in s.attrs.items()},
+                          'inputs': [[i, 0, 0] for i in in_idx]})
+            index[id(s)] = idx
+            return idx
+
+        visit(self)
+        return json.dumps({'nodes': nodes, 'heads': [[len(nodes) - 1, 0, 0]],
+                           'mxnet_tpu_version': 2}, indent=2)
+
+    def save(self, fname):
+        with open(fname, 'w') as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+
+class _SymbolList(list):
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            for s in self:
+                if s.name == key or s.name + '_output' == key:
+                    return s
+            raise MXNetError(f"no internal symbol {key}")
+        return super().__getitem__(key)
+
+
+def _eval_node(s, bindings, cache):
+    key = (id(s), s.out_index)
+    base_key = id(s)
+    if base_key in cache:
+        out = cache[base_key]
+    elif s.op is None:
+        if s._name not in bindings:
+            raise MXNetError(f"unbound variable {s._name}")
+        out = bindings[s._name]
+        cache[base_key] = out
+    else:
+        in_vals = [_eval_node(i, bindings, cache) for i in s.inputs]
+        opdef = get_op(s.op)
+        out = opdef.fn(*in_vals, **s.attrs)
+        cache[base_key] = out
+    if isinstance(out, tuple):
+        return out[s.out_index]
+    return out
+
+
+def _apply(opname, inputs, attrs, name=None):
+    get_op(opname)  # validate
+    return Symbol(opname, inputs, attrs, name)
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
+        lr_mult=None, wd_mult=None, **kwargs):
+    """Ref: symbol.py var/Variable."""
+    s = Symbol(None, (), attr, name)
+    if shape is not None:
+        s.attrs['__shape__'] = shape
+    return s
+
+
+Variable = var
+
+
+def zeros(shape, dtype='float32', **kwargs):
+    return _apply('zeros', [], {'shape': shape, 'dtype': dtype})
+
+
+def ones(shape, dtype='float32', **kwargs):
+    return _apply('ones', [], {'shape': shape, 'dtype': dtype})
+
+
+def load(fname):
+    with open(fname) as f:
+        data = json.load(f)
+    return fromjson(json.dumps(data))
+
+
+def fromjson(js):
+    data = json.loads(js)
+    nodes = data['nodes']
+    built = []
+    for node in nodes:
+        inputs = [built[i[0]] for i in node['inputs']]
+        attrs = {}
+        for k, v in node.get('attrs', {}).items():
+            try:
+                attrs[k] = eval(v, {'__builtins__': {}})  # literals only
+            except Exception:
+                attrs[k] = v
+        if node['op'] == 'null':
+            built.append(var(node['name']))
+        else:
+            built.append(Symbol(node['op'], inputs, attrs, node['name']))
+    head = data['heads'][0][0]
+    return built[head]
+
+
+class Executor:
+    """Compiled executor (ref: include/mxnet/executor.h:53, python
+    executor.py). forward/backward each run one jitted XLA call."""
+
+    def __init__(self, symbol, args, args_grad, grad_req, ctx):
+        self._symbol = symbol
+        self.arg_dict = args
+        self.grad_dict = args_grad
+        self._grad_req = grad_req
+        self._ctx = ctx
+        self._names = symbol.list_arguments()
+        self.outputs = []
+        self._jit_fwd = None
+        self._vjp = None
+
+        def f(bind):
+            return _eval_node(symbol, bind, {})
+
+        self._f = f
+        self._jit_fwd = jax.jit(f)
+
+    @property
+    def aux_dict(self):
+        return {}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k]._data = jnp.asarray(v)
+        bind = {n: self.arg_dict[n]._data for n in self._names}
+        if is_train and self._grad_req != 'null':
+            out, self._vjp = jax.vjp(self._f, bind)
+        else:
+            out = self._jit_fwd(bind)
+            self._vjp = None
+        self.outputs = [_wrap(out)]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._vjp is None:
+            raise MXNetError("call forward(is_train=True) before backward")
+        if out_grads is None:
+            ct = jnp.ones_like(self.outputs[0]._data)
+        elif isinstance(out_grads, NDArray):
+            ct = out_grads._data
+        elif isinstance(out_grads, (list, tuple)):
+            ct = out_grads[0]._data
+        else:
+            ct = jnp.asarray(out_grads)
+        grads = self._vjp(ct)[0]
+        for n, g in grads.items():
+            if n in self.grad_dict and self.grad_dict[n] is not None:
+                if self._grad_req == 'add':
+                    self.grad_dict[n]._data = self.grad_dict[n]._data + g
+                else:
+                    self.grad_dict[n]._data = g
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        new_args = {}
+        for n in self._names:
+            shape = kwargs.get(n, self.arg_dict[n].shape)
+            new_args[n] = nd_zeros(shape, self._ctx)
+        grads = {n: nd_zeros(new_args[n].shape, self._ctx)
+                 for n in self._names} if self._grad_req != 'null' else {}
+        return Executor(self._symbol, new_args, grads, self._grad_req,
+                        self._ctx)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = arr._data
+            elif not allow_extra_params:
+                raise MXNetError(f"extra param {name}")
+
+
+class _OpMaker:
+    """Populate sym.<op> wrappers mirroring nd.<op>."""
+
+    @staticmethod
+    def populate(namespace):
+        def make(opname):
+            def fn(*args, name=None, **kwargs):
+                sym_inputs = [a for a in args if isinstance(a, Symbol)]
+                attrs = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Symbol)}
+                sym_inputs += [v for v in kwargs.values()
+                               if isinstance(v, Symbol)]
+                return _apply(opname, sym_inputs, attrs, name)
+            fn.__name__ = opname
+            return fn
+
+        for opname in _OP_REGISTRY:
+            if opname not in namespace:
+                namespace[opname] = make(opname)
+
+
+_OpMaker.populate(globals())
+
+# CamelCase legacy aliases (ref: symbol API: FullyConnected, Convolution...)
+_CAMEL = {
+    'FullyConnected': 'fully_connected', 'Convolution': 'convolution',
+    'Deconvolution': 'deconvolution', 'Pooling': 'pooling',
+    'Activation': 'activation', 'BatchNorm': 'batch_norm',
+    'LayerNorm': 'layer_norm', 'Dropout': 'dropout', 'Flatten': 'flatten',
+    'SoftmaxOutput': 'softmax_output', 'Embedding': 'embedding',
+    'Concat': 'concat', 'LeakyReLU': 'leaky_relu', 'RNN': 'rnn',
+    'SequenceMask': 'sequence_mask', 'SequenceLast': 'sequence_last',
+    'SequenceReverse': 'sequence_reverse', 'SliceChannel': 'split',
+    'UpSampling': 'upsampling', 'LRN': 'lrn', 'Cast': 'cast',
+    'SwapAxis': 'swapaxes', 'Reshape': 'reshape',
+}
+for camel, snake in _CAMEL.items():
+    if snake in globals():
+        globals()[camel] = globals()[snake]

@@ -1,0 +1,151 @@
+"""Test helpers (ref: python/mxnet/test_utils.py — 95 helpers)."""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array
+from . import autograd
+
+
+def default_context() -> Context:
+    """Context under test; override with MXNET_TEST_DEVICE (ref:
+    test_utils.py default_context)."""
+    dev = os.environ.get('MXNET_TEST_DEVICE', 'cpu')
+    if dev.startswith('gpu') or dev.startswith('tpu'):
+        from .context import gpu
+        return gpu(0)
+    return cpu(0)
+
+
+def default_dtype():
+    return onp.float32
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=('a', 'b'),
+                        equal_nan=False):
+    a = _as_np(a)
+    b = _as_np(b)
+    onp.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                equal_nan=equal_nan,
+                                err_msg=f"{names[0]} != {names[1]}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return onp.array_equal(_as_np(a), _as_np(b))
+
+
+def rand_ndarray(shape, stype='default', density=None, dtype=None, ctx=None):
+    data = onp.random.uniform(-1, 1, size=shape).astype(dtype or onp.float32)
+    arr = array(data, ctx=ctx)
+    if stype != 'default':
+        from .ndarray import sparse
+        return sparse.cast_storage(arr, stype)
+    return arr
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1),
+            onp.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=num_dim))
+
+
+def check_numeric_gradient(f, inputs, eps=1e-4, rtol=1e-2, atol=1e-4):
+    """Finite-difference gradient check for a scalar-output function over
+    NDArray inputs (ref: test_utils.py check_numeric_gradient, adapted to the
+    functional API: f takes NDArrays, returns a scalar NDArray)."""
+    inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        y = f(*inputs)
+    y.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        xv = x.asnumpy().astype(onp.float64)
+        num_grad = onp.zeros_like(xv)
+        flat = xv.ravel()
+        ng_flat = num_grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            xp = array(xv.astype(onp.float32))
+            yp = f(*[xp if j == xi else inputs[j] for j in range(len(inputs))])
+            flat[i] = orig - eps
+            xm = array(xv.astype(onp.float32))
+            ym = f(*[xm if j == xi else inputs[j] for j in range(len(inputs))])
+            flat[i] = orig
+            ng_flat[i] = (yp.asscalar() - ym.asscalar()) / (2 * eps)
+        onp.testing.assert_allclose(analytic[xi], num_grad, rtol=rtol, atol=atol,
+                                    err_msg=f"gradient mismatch for input {xi}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-3, atol=1e-4):
+    """Run fn on multiple contexts and compare outputs (ref:
+    test_utils.py check_consistency)."""
+    if ctx_list is None:
+        ctx_list = [cpu(0)]
+    results = []
+    for ctx in ctx_list:
+        ctx_inputs = [x.as_in_context(ctx) for x in inputs]
+        results.append(_as_np(fn(*ctx_inputs)))
+    for r in results[1:]:
+        onp.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
+    return results
+
+
+def discard_stderr():
+    import contextlib
+    import sys
+
+    @contextlib.contextmanager
+    def _ctx():
+        with open(os.devnull, 'w') as devnull:
+            old = sys.stderr
+            sys.stderr = devnull
+            try:
+                yield
+            finally:
+                sys.stderr = old
+    return _ctx()
+
+
+class EnvManager:
+    def __init__(self, key, val):
+        self._key = key
+        self._next_val = val
+        self._prev_val = None
+
+    def __enter__(self):
+        self._prev_val = os.environ.get(self._key)
+        os.environ[self._key] = self._next_val
+
+    def __exit__(self, *exc):
+        if self._prev_val:
+            os.environ[self._key] = self._prev_val
+        elif self._key in os.environ:
+            del os.environ[self._key]

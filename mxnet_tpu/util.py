@@ -1,0 +1,96 @@
+"""Utility switches (ref: python/mxnet/util.py np-shape/array semantics)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+_tls = threading.local()
+
+
+def _flags():
+    if not hasattr(_tls, 'np_shape'):
+        _tls.np_shape = True
+        _tls.np_array = False
+        _tls.np_default_dtype = False
+    return _tls
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+def set_np_shape(active):
+    prev = _flags().np_shape
+    _flags().np_shape = bool(active)
+    return prev
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+def set_np_array(active):
+    prev = _flags().np_array
+    _flags().np_array = bool(active)
+    return prev
+
+
+def set_np(shape=True, array=True, dtype=False):
+    set_np_shape(shape)
+    set_np_array(array)
+
+
+def reset_np():
+    set_np(False, False, False)
+
+
+class np_shape:
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+
+class np_array:
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_array(self._active)
+
+    def __exit__(self, *exc):
+        set_np_array(self._prev)
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np_array(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_array(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np(func):
+    return use_np_array(use_np_shape(func))
+
+
+def getenv(name):
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = value

@@ -1,0 +1,57 @@
+"""Network visualization (ref: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Textual summary of a Symbol graph (ref: visualization.py print_summary)."""
+    nodes = []
+
+    def visit(s, depth=0):
+        for i in s.inputs:
+            visit(i, depth + 1)
+        if s not in nodes:
+            nodes.append(s)
+
+    visit(symbol)
+    line = '_' * line_length
+    print(line)
+    header = ['Layer (type)', 'Output Shape', 'Param #', 'Previous Layer']
+    pos = [int(line_length * p) for p in positions]
+    row = ''
+    for name, p in zip(header, pos):
+        row = row[:p - len(name)] if len(row) > p - len(name) else row
+        row += name.ljust(p - len(row))
+    print(row)
+    print('=' * line_length)
+    for node in nodes:
+        op = node.op or 'Variable'
+        fields = [f"{node.name} ({op})", '', '0',
+                  ','.join(i.name for i in node.inputs)]
+        row = ''
+        for f, p in zip(fields, pos):
+            row += str(f).ljust(p - len(row))[:p - len(row)]
+        print(row)
+    print('=' * line_length)
+
+
+def plot_network(symbol, title='plot', save_format='pdf', shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering; returns a Digraph if graphviz is installed."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires graphviz (not installed); "
+                         "use print_summary instead")
+    dot = Digraph(name=title)
+    def visit(s, seen):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        dot.node(str(id(s)), f"{s.name}\n{s.op or 'var'}")
+        for i in s.inputs:
+            visit(i, seen)
+            dot.edge(str(id(i)), str(id(s)))
+    visit(symbol, set())
+    return dot

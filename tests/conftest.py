@@ -1,0 +1,21 @@
+"""Test config: run on a virtual 8-device CPU mesh (SURVEY §4 pattern —
+multi-device tests without a cluster, like the reference's multiple logical
+mx.gpu(i) contexts in one process)."""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+prev = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in prev:
+    os.environ['XLA_FLAGS'] = (
+        prev + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    onp.random.seed(0)
+    yield
