@@ -18,14 +18,16 @@ from .base import state
 
 
 class TapeNode:
-    __slots__ = ("inputs", "outputs", "vjp_fn", "fn", "name")
+    __slots__ = ("inputs", "outputs", "vjp_fn", "fn", "name", "tuple_out")
 
-    def __init__(self, inputs, outputs, vjp_fn, fn=None, name=""):
+    def __init__(self, inputs, outputs, vjp_fn, fn=None, name="",
+                 tuple_out=False):
         self.inputs = inputs      # list of NDArray
         self.outputs = outputs    # list of NDArray
         self.vjp_fn = vjp_fn      # cotangent(s) -> input cotangents
         self.fn = fn              # pure fn over jax arrays (for create_graph)
         self.name = name
+        self.tuple_out = tuple_out  # fn returned a tuple (vs single array)
 
 
 class _Tape(threading.local):
@@ -78,8 +80,12 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
     return out_data, tensor_inputs, vjp_fn, g
 
 
-def record_node(tensor_inputs, outputs, vjp_fn, fn=None, name=""):
-    node = TapeNode(list(tensor_inputs), list(outputs), vjp_fn, fn, name)
+def record_node(tensor_inputs, outputs, vjp_fn, fn=None, name="",
+                tuple_out=None):
+    if tuple_out is None:
+        tuple_out = len(outputs) > 1
+    node = TapeNode(list(tensor_inputs), list(outputs), vjp_fn, fn, name,
+                    tuple_out)
     for o in outputs:
         o._in_graph = True
     tape.nodes.append(node)
@@ -114,11 +120,13 @@ def _accumulate(grad_map, heads, head_grads, nodes, create_graph):
             n_in = len(node.inputs)
             node_fn = node.fn
 
-            def bwd(*datas, _n_in=n_in, _fn=node_fn):
+            tuple_out = node.tuple_out
+
+            def bwd(*datas, _n_in=n_in, _fn=node_fn, _tup=tuple_out):
                 in_datas = datas[:_n_in]
                 ct_datas = datas[_n_in:]
                 _, vjp2 = jax.vjp(_fn, *in_datas)
-                ct_s = ct_datas[0] if len(ct_datas) == 1 else tuple(ct_datas)
+                ct_s = tuple(ct_datas) if _tup else ct_datas[0]
                 return vjp2(ct_s)
 
             out_data, t_inputs, vjp_fn2, gfn = invoke(
@@ -130,8 +138,8 @@ def _accumulate(grad_map, heads, head_grads, nodes, create_graph):
                 record_node(t_inputs, rec_outs, vjp_fn2, gfn,
                             "grad_" + node.name)
         else:
-            ct_struct = (ct_arrs[0]._data if len(node.outputs) == 1
-                         else tuple(c._data for c in ct_arrs))
+            ct_struct = (tuple(c._data for c in ct_arrs) if node.tuple_out
+                         else ct_arrs[0]._data)
             in_cts = node.vjp_fn(ct_struct)
             in_ct_arrs = [None if _is_float0(d) else _wrap(d) for d in in_cts]
 

@@ -39,12 +39,16 @@ class _BlockScope:
         self._counter = {}
         self._old_scope = None
 
+    _global_counter = {}
+
     @staticmethod
     def create(prefix, params, hint):
         current = getattr(_BlockScope._current, 'value', None)
         if current is None:
             if prefix is None:
-                prefix = hint + '0_'
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = f"{hint}{count}_"
             if params is None:
                 params = ParameterDict(prefix)
             else:
@@ -382,23 +386,37 @@ class CachedOp:
         def run(*datas):
             n = len(params)
             pd = {name: d for (name, _), d in zip(params, datas[:n])}
-            return jitted(pd, list(datas[n:]), rng)
+            outs, aux = jitted(pd, list(datas[n:]), rng)
+            return tuple(outs) + tuple(aux)
 
         all_inputs = param_arrs + input_arrs
         out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(
             run, tuple(all_inputs), {})
-        outs_flat, aux = out_data
-        # write back mutated aux states (running stats)
+        n_aux = len(aux_names)
+        if n_aux:
+            outs_flat, aux = out_data[:-n_aux], out_data[-n_aux:]
+        else:
+            outs_flat, aux = out_data, ()
+        # write back mutated aux states (running stats). Inside an outer
+        # trace, write to the outer proxy so the mutation is threaded out
+        # functionally; otherwise update the real storage.
         name_to_param = dict(params)
         for name, new_val in zip(aux_names, aux):
             p = name_to_param[name]
-            for d in p._data:
-                d._data = new_val
+            proxy = p._trace_proxy
+            if proxy is not None:
+                proxy._data = new_val
+            else:
+                for d in p._data:
+                    d._data = new_val
 
         out_arrs = [_wrap(o) for o in outs_flat]
         if vjp_fn is not None:
-            _imperative.record_node(tensor_inputs, out_arrs, vjp_fn, gfn,
-                                    f"cachedop_{self.block.name}")
+            aux_arrs = [_wrap(a) for a in aux]
+            _imperative.record_node(tensor_inputs, out_arrs + aux_arrs,
+                                    vjp_fn, gfn,
+                                    f"cachedop_{self.block.name}",
+                                    tuple_out=True)
         if len(out_arrs) == 1:
             return out_arrs[0]
         return tuple(out_arrs)

@@ -46,16 +46,23 @@ class Parameter:
         self._deferred_init = ()
         self._trace_tls = threading.local()
 
-    # --- trace override: CachedOp substitutes tracer-backed proxies --------
+    # --- trace override: CachedOp substitutes tracer-backed proxies.
+    # A stack, because hybridized blocks nest (a child CachedOp traces
+    # inside its parent's trace and must restore the parent's proxies).
     def _set_trace_proxy(self, arr):
-        self._trace_tls.proxy = arr
+        if not hasattr(self._trace_tls, 'proxies'):
+            self._trace_tls.proxies = []
+        self._trace_tls.proxies.append(arr)
 
     def _clear_trace_proxy(self):
-        self._trace_tls.proxy = None
+        stack = getattr(self._trace_tls, 'proxies', None)
+        if stack:
+            stack.pop()
 
     @property
     def _trace_proxy(self):
-        return getattr(self._trace_tls, 'proxy', None)
+        stack = getattr(self._trace_tls, 'proxies', None)
+        return stack[-1] if stack else None
 
     # ------------------------------------------------------------------
     @property

@@ -214,6 +214,11 @@ class LSTMBias(Initializer):
     _init_default = _init_weight
 
 
+_REG.register(Zero, name='zeros')
+_REG.register(One, name='ones')
+_REG.register(Normal, name='gaussian')
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name

@@ -433,7 +433,8 @@ def _invoke(fn, *args, **kwargs):
         outs = [NDArray(o) for o in out_data]
         if vjp_fn is not None:
             _imperative.record_node(tensor_inputs, outs, vjp_fn, gfn,
-                                    getattr(fn, '__name__', 'op'))
+                                    getattr(fn, '__name__', 'op'),
+                                    tuple_out=True)
         return tuple(outs)
     out = NDArray(out_data)
     if vjp_fn is not None:

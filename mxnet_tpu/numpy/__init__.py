@@ -156,7 +156,7 @@ _FUNCS = [
     'exp', 'expm1', 'log', 'log2', 'log10', 'log1p', 'sin', 'cos', 'tan',
     'arcsin', 'arccos', 'arctan', 'arctan2', 'sinh', 'cosh', 'tanh', 'arcsinh',
     'arccosh', 'arctanh', 'degrees', 'radians', 'abs', 'absolute', 'fabs',
-    'sign', 'floor', 'ceil', 'trunc', 'rint', 'fix', 'around', 'round',
+    'sign', 'floor', 'ceil', 'trunc', 'rint', 'around', 'round',
     'reciprocal', 'negative', 'maximum', 'minimum', 'clip', 'sum', 'prod',
     'mean', 'std', 'var', 'min', 'max', 'amin', 'amax', 'argmin', 'argmax',
     'cumsum', 'cumprod', 'reshape', 'ravel', 'transpose', 'swapaxes',

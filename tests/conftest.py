@@ -2,12 +2,19 @@
 multi-device tests without a cluster, like the reference's multiple logical
 mx.gpu(i) contexts in one process)."""
 import os
+import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
 prev = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in prev:
     os.environ['XLA_FLAGS'] = (
         prev + ' --xla_force_host_platform_device_count=8').strip()
+# Tests are CPU-hermetic. jax may already be imported (TPU-tunnel site
+# hooks import it at interpreter start and freeze the env-derived platform
+# selection), so force the platform through the config API too.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402

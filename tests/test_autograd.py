@@ -1,0 +1,148 @@
+"""Autograd semantics (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_backward():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2., 4., 6.])
+
+
+def test_chain():
+    x = nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * onp.exp([[1, 2], [3, 4]]), rtol=1e-5)
+
+
+def test_head_gradient():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10., 100.]))
+    assert_almost_equal(x.grad, [30., 300.])
+
+
+def test_grad_req_add():
+    x = nd.array([1., 1.])
+    x.attach_grad(grad_req='add')
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6., 6.])
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [4.])  # only d(y_const * x)/dx = y = 4
+    with autograd.record():
+        w = nd.blockgrad(x * x) * x
+    w.backward()
+    assert_almost_equal(x.grad, [4.])
+
+
+def test_pause_and_modes():
+    x = nd.array([1.])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            y = x * 2  # not recorded
+        z = x * 3
+    z.backward()
+    assert_almost_equal(x.grad, [3.])
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_grad_function():
+    x = nd.array([3.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    dx = autograd.grad(y, x)
+    assert_almost_equal(dx, [6.])
+
+
+def test_higher_order_grad():
+    x = nd.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x          # y = x^3
+        dx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = dx * 1
+    z.backward()
+    # d2y/dx2 = 6x = 12
+    assert_almost_equal(x.grad, [12.], rtol=1e-5)
+
+
+def test_multi_output_backward():
+    x = nd.array([[1., 2., 3.], [4., 5., 6.]])
+    x.attach_grad()
+    with autograd.record():
+        parts = x.split(3, axis=1)
+        y = parts[0].sum() + 2 * parts[2].sum()
+    y.backward()
+    assert_almost_equal(x.grad, [[1, 0, 2], [1, 0, 2]])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self._x = x
+            return x * x
+
+        def backward(self, dy):
+            return 2 * self._x * dy
+
+    x = nd.array([3.])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, [6.])
+
+
+def test_mark_variables():
+    x = nd.array([1., 2.])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [5., 5.])
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    out_predict = nd.dropout(x, p=0.5)
+    assert_almost_equal(out_predict, onp.ones((100, 100)))
+    with autograd.record():
+        out_train = nd.dropout(x, p=0.5)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7

@@ -1,0 +1,200 @@
+"""Gluon blocks/params/hybridize (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_forward():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    x = nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 4)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert_almost_equal(out, onp.ones((2, 3)).dot(w.T) + b, rtol=1e-5)
+
+
+def test_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.ones((2, 7))
+    out = net(x)
+    assert out.shape == (2, 4)
+    assert net.weight.shape == (4, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation='relu'))
+    net.add(nn.Dense(3))
+    net.initialize()
+    out = net(nd.ones((2, 5)))
+    assert out.shape == (2, 3)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_collect_params_naming():
+    net = nn.HybridSequential(prefix='model_')
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(2))
+    params = net.collect_params()
+    names = list(params.keys())
+    assert all(n.startswith('model_') for n in names)
+    assert len(names) == 4
+
+
+def test_param_save_load(tmp_path):
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    fname = str(tmp_path / 'p.params')
+    net.save_parameters(fname)
+    net2 = nn.Dense(3, in_units=2)
+    net2.load_parameters(fname)
+    assert_almost_equal(net.weight.data(), net2.weight.data())
+
+
+def test_conv_pool():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation='relu'))
+    net.add(nn.MaxPool2D(2, 2))
+    net.initialize()
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_batchnorm_train_inference():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array(onp.random.randn(4, 3, 2, 2).astype(onp.float32))
+    with autograd.record():
+        out = net(x)
+    xn = x.asnumpy()
+    mean = xn.mean(axis=(0, 2, 3))
+    var = xn.var(axis=(0, 2, 3))
+    expect = (xn - mean[None, :, None, None]) / onp.sqrt(
+        var[None, :, None, None] + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+    # running stats updated
+    rm = net.running_mean.data().asnumpy()
+    assert_almost_equal(rm, 0.1 * mean, rtol=1e-3, atol=1e-5)
+    # inference uses running stats
+    out2 = net(x)
+    rv = net.running_var.data().asnumpy()
+    expect2 = (xn - rm[None, :, None, None]) / onp.sqrt(
+        rv[None, :, None, None] + 1e-5)
+    assert_almost_equal(out2, expect2, rtol=1e-3, atol=1e-4)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.array(onp.random.rand(5, 8).astype(onp.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5)
+    # grads through hybridized path
+    for p in net.collect_params().values():
+        pass
+    x2 = nd.array(onp.random.rand(5, 8).astype(onp.float32))
+    w = net[0].weight
+    with autograd.record():
+        loss = (net(x2) ** 2).sum()
+    loss.backward()
+    g_hybrid = w.grad().asnumpy().copy()
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, activation='relu'))
+    net2.add(nn.Dense(4))
+    net2.initialize()
+    for (n1, p1), (n2, p2) in zip(sorted(net.collect_params().items()),
+                                  sorted(net2.collect_params().items())):
+        p2.set_data(p1.data())
+    with autograd.record():
+        loss2 = (net2(x2) ** 2).sum()
+    loss2.backward()
+    assert_almost_equal(g_hybrid, net2[0].weight.grad(), rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_batchnorm_stats_update():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.add(nn.BatchNorm(in_channels=4))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(onp.random.rand(8, 3).astype(onp.float32))
+    before = net[1].running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net[1].running_mean.data().asnumpy()
+    assert not onp.allclose(before, after)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    net.weight.set_data(nd.array([[1.0, 1.0]]))
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    x = nd.array([[1., 2.]])
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    # grad = [1, 2]; w = w - 0.1*grad
+    assert_almost_equal(net.weight.data(), [[0.9, 0.8]], rtol=1e-6)
+
+
+def test_embedding_layer():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    out = net(nd.array([1, 3]))
+    assert out.shape == (2, 4)
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+    pred = nd.array([[1., 2., 3.], [3., 2., 1.]])
+    label = nd.array([2, 0])
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    expect = -onp.log(onp.exp([3, 3]) / onp.exp([[1, 2, 3], [3, 2, 1]])
+                      .sum(axis=1))
+    assert_almost_equal(l, expect, rtol=1e-5)
+    l2 = gloss.L2Loss()(nd.array([1., 2.]), nd.array([0., 0.]))
+    assert_almost_equal(l2, [0.5, 2.0])
+    l1 = gloss.L1Loss()(nd.array([1., -2.]), nd.array([0., 0.]))
+    assert_almost_equal(l1, [1., 2.])
+
+
+def test_lambda_blocks():
+    net = nn.HybridLambda('tanh')
+    out = net(nd.array([0.]))
+    assert_almost_equal(out, [0.])
+    net2 = nn.Lambda(lambda x: x * 2)
+    assert_almost_equal(net2(nd.array([3.])), [6.])
+
+
+def test_global_norm_clip():
+    from mxnet_tpu.gluon.utils import clip_global_norm
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = clip_global_norm(arrays, 1.0)
+    total = onp.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-5
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=2))
+    net.initialize()
+    repr(net)
+    net.summary(nd.ones((1, 2)))
+    captured = capsys.readouterr()
+    assert 'Total params' in captured.out
