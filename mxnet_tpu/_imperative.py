@@ -169,6 +169,21 @@ def _seed_heads(heads, head_grads):
     return grad_map
 
 
+def _ancestors(nodes, heads):
+    """Nodes reachable backwards from heads (the subgraph this backward
+    consumes — other recorded subgraphs stay on the tape, matching the
+    reference's per-graph backward semantics)."""
+    needed = {id(h) for h in heads}
+    marked = []
+    for node in reversed(nodes):
+        if any(id(o) in needed for o in node.outputs):
+            marked.append(node)
+            for i in node.inputs:
+                needed.add(id(i))
+    marked.reverse()
+    return marked
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Reverse pass writing into leaf `.grad` arrays (ref:
     Imperative::Backward, src/imperative/imperative.cc:280)."""
@@ -179,7 +194,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     elif not isinstance(head_grads, (list, tuple)):
         head_grads = [head_grads]
 
-    nodes = list(tape.nodes)
+    nodes = _ancestors(tape.nodes, heads)
     grad_map = _seed_heads(heads, head_grads)
     rec = state.is_recording
     state.is_recording = False
@@ -201,7 +216,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             _write_grad(h, grad_map[id(h)])
 
     if not retain_graph:
-        tape.clear()
+        consumed = set(map(id, nodes))
+        tape.nodes = [n for n in tape.nodes if id(n) not in consumed]
 
 
 def _write_grad(arr, g):

@@ -20,6 +20,13 @@ class _RecordingStateScope:
     def __enter__(self):
         if self._enter_is_record is not None:
             self._prev_is_record = state.is_recording
+            # entering a fresh top-level record scope drops stale nodes left
+            # by heads that were never backwarded (selective pruning in
+            # backward() keeps non-ancestor nodes alive; without this, a
+            # training loop recording auxiliary outputs would grow the tape
+            # — and pin device memory — unboundedly)
+            if self._enter_is_record and not state.is_recording:
+                _imperative.tape.clear()
             state.is_recording = self._enter_is_record
         if self._enter_train_mode is not None:
             self._prev_train_mode = state.is_training

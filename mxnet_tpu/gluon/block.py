@@ -408,7 +408,8 @@ class CachedOp:
                 proxy._data = new_val
             else:
                 for d in p._data:
-                    d._data = new_val
+                    d._data = jax.device_put(new_val,
+                                             list(d._data.devices())[0])
 
         out_arrs = [_wrap(o) for o in outs_flat]
         if vjp_fn is not None:

@@ -201,9 +201,16 @@ class Parameter:
                 self._finish_deferred_init()
             else:
                 raise MXNetError(f"Parameter '{self.name}' not initialized")
+        import jax
+        if tuple(data.shape) != tuple(self._data[0].shape):
+            raise MXNetError(
+                f"Parameter '{self.name}': shape mismatch in set_data: "
+                f"expected {tuple(self._data[0].shape)}, got {tuple(data.shape)}")
+        src = data._data
+        if src.dtype != self._data[0]._data.dtype:
+            src = src.astype(self._data[0]._data.dtype)
         for d in self._data:
-            d._data = data._data.astype(d._data.dtype) \
-                if data._data.dtype != d._data.dtype else data._data
+            d._data = jax.device_put(src, list(d._data.devices())[0])
         return self
 
     def zero_grad(self):

@@ -139,11 +139,24 @@ class Trainer:
                 self._kvstore.push(i, param.list_grad())
                 self._kvstore.pull(i, param.list_data())
             return
+        import jax
+        from ..kvstore.kvstore import _reduce
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
                 continue
-            for data, grad in zip(param.list_data(), param.list_grad()):
-                self._updater(i, grad, data)
+            datas = param.list_data()
+            grads = param.list_grad()
+            # after allreduce every ctx grad is identical; with no kvstore
+            # the reduction happens here so no context's contribution drops
+            g = grads[0] if (self._kvstore is not None or len(grads) == 1) \
+                else _reduce(grads)
+            # update the first copy (optimizer state lives with it),
+            # broadcast to the rest (ref: trainer.py:430 per-device update;
+            # collapsed so state copies don't ping-pong between devices)
+            self._updater(i, g, datas[0])
+            src = datas[0]._data
+            for d in datas[1:]:
+                d._data = jax.device_put(src, list(d._data.devices())[0])
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -168,3 +181,7 @@ class Trainer:
         self._updater.set_states(states)
         if hasattr(self._updater, 'optimizer'):
             self._optimizer = self._updater.optimizer
+            # re-attach live params: __getstate__ drops param_dict, so
+            # per-parameter lr_mult/wd_mult must be rebound after restore
+            self._optimizer.param_dict = {
+                i: p for i, p in enumerate(self._params)}

@@ -239,12 +239,14 @@ def _group(keys, values):
 
 
 def _reduce(vlist):
-    """Sum device copies (ref: CommDevice::Reduce, src/kvstore/comm.h:451)."""
+    """Sum device copies on the first copy's device (ref:
+    CommDevice::Reduce, src/kvstore/comm.h:451 — gather-to-one then sum)."""
     if len(vlist) == 1:
         return NDArray(vlist[0]._data)
+    dev = list(vlist[0]._data.devices())[0]
     acc = vlist[0]._data
     for v in vlist[1:]:
-        acc = acc + v._data
+        acc = acc + jax.device_put(v._data, dev)
     return NDArray(acc)
 
 

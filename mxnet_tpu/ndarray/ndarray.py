@@ -76,9 +76,15 @@ class NDArray:
             dev = self._data.devices().pop() if hasattr(self._data, 'devices') else None
         except Exception:
             dev = None
-        if dev is not None and dev.platform != 'cpu':
-            return Context('gpu', 0)
-        return Context('cpu', 0)
+        if dev is None:
+            return Context('cpu', 0)
+        if dev.platform != 'cpu':
+            accel = [d for d in jax.devices() if d.platform != 'cpu']
+            idx = accel.index(dev) if dev in accel else 0
+            return Context('gpu', idx)
+        cpus = jax.devices('cpu')
+        idx = cpus.index(dev) if dev in cpus else 0
+        return Context('cpu', idx)
 
     ctx = context
 

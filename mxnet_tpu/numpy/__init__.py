@@ -183,6 +183,10 @@ for _f in _FUNCS:
     if hasattr(jnp, _f):
         globals()[_f] = _make(_f)
 
+
+def fix(x):
+    return ndarray(jnp.trunc(_unwrap(x)))
+
 pi = _onp.pi
 e = _onp.e
 inf = _onp.inf

@@ -151,10 +151,14 @@ class Optimizer:
 
     def __getstate__(self):
         ret = self.__dict__.copy()
+        # param_dict holds live Parameter objects (thread-local trace state,
+        # device arrays) — not serialisable and re-attached by Trainer.
+        ret['param_dict'] = {}
         return ret
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self.param_dict = {}
 
 
 def _cg(v):

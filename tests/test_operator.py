@@ -1,0 +1,318 @@
+"""Per-op numerical checks against numpy (ref:
+tests/python/unittest/test_operator.py — the backbone suite)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _r(*shape):
+    return onp.random.uniform(-1, 1, shape).astype(onp.float32)
+
+
+def test_unary_ops():
+    x = _r(3, 4)
+    assert_almost_equal(nd.exp(nd.array(x)), onp.exp(x), rtol=1e-5)
+    assert_almost_equal(nd.log(nd.array(onp.abs(x) + 1)), onp.log(onp.abs(x) + 1), rtol=1e-5)
+    assert_almost_equal(nd.sqrt(nd.array(onp.abs(x))), onp.sqrt(onp.abs(x)), rtol=1e-5)
+    assert_almost_equal(nd.square(nd.array(x)), x ** 2, rtol=1e-5)
+    assert_almost_equal(nd.abs(nd.array(x)), onp.abs(x))
+    assert_almost_equal(nd.sign(nd.array(x)), onp.sign(x))
+    assert_almost_equal(nd.tanh(nd.array(x)), onp.tanh(x), rtol=1e-5)
+    assert_almost_equal(nd.sigmoid(nd.array(x)), 1 / (1 + onp.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.relu(nd.array(x)), onp.maximum(x, 0))
+    assert_almost_equal(nd.reciprocal(nd.array(x + 3)), 1 / (x + 3), rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(nd.array(onp.abs(x) + 1)),
+                        1 / onp.sqrt(onp.abs(x) + 1), rtol=1e-5)
+
+
+def test_binary_broadcast():
+    a = _r(2, 1, 4)
+    b = _r(1, 3, 4)
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)),
+                        onp.maximum(a, b))
+    assert_almost_equal(nd.broadcast_power(nd.array(onp.abs(a)), nd.array(b)),
+                        onp.abs(a) ** b, rtol=1e-4)
+    assert_almost_equal(nd.broadcast_like(nd.array(onp.ones((1, 4))),
+                                          nd.array(onp.zeros((3, 4)))),
+                        onp.ones((3, 4)))
+
+
+def test_fully_connected():
+    x = _r(4, 5)
+    w = _r(3, 5)
+    b = _r(3)
+    out = nd.fully_connected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x.dot(w.T) + b, rtol=1e-5)
+    out_nb = nd.fully_connected(nd.array(x), nd.array(w), no_bias=True, num_hidden=3)
+    assert_almost_equal(out_nb, x.dot(w.T), rtol=1e-5)
+    # flatten semantics
+    x4 = _r(2, 3, 2, 2)
+    w2 = _r(7, 12)
+    out2 = nd.fully_connected(nd.array(x4), nd.array(w2), no_bias=True, num_hidden=7)
+    assert_almost_equal(out2, x4.reshape(2, -1).dot(w2.T), rtol=1e-5)
+
+
+def test_convolution_vs_reference():
+    import torch
+    import torch.nn.functional as F
+    x = _r(2, 3, 8, 8)
+    w = _r(5, 3, 3, 3)
+    b = _r(5)
+    out = nd.convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=5)
+    ref = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                   stride=2, padding=1).numpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_and_dilated_conv():
+    import torch
+    import torch.nn.functional as F
+    x = _r(1, 4, 9, 9)
+    w = _r(8, 2, 3, 3)
+    out = nd.convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=8, num_group=2,
+                         dilate=(2, 2))
+    ref = F.conv2d(torch.tensor(x), torch.tensor(w), groups=2,
+                   dilation=2).numpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution():
+    import torch
+    import torch.nn.functional as F
+    x = _r(2, 4, 5, 5)
+    w = _r(4, 3, 4, 4)
+    out = nd.deconvolution(nd.array(x), nd.array(w), no_bias=True,
+                           kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                           num_filter=3)
+    ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                             padding=1).numpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    import torch
+    import torch.nn.functional as F
+    x = _r(2, 3, 8, 8)
+    out = nd.pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type='max')
+    ref = F.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert_almost_equal(out, ref)
+    out_avg = nd.pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                         pad=(1, 1), pool_type='avg')
+    ref_avg = F.avg_pool2d(torch.tensor(x), 3, 2, 1).numpy()
+    assert_almost_equal(out_avg, ref_avg, rtol=1e-5)
+    out_g = nd.pooling(nd.array(x), global_pool=True, pool_type='avg')
+    assert_almost_equal(out_g, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+
+
+def test_softmax_family():
+    x = _r(3, 5)
+    ex = onp.exp(x - x.max(axis=-1, keepdims=True))
+    sm = ex / ex.sum(axis=-1, keepdims=True)
+    assert_almost_equal(nd.softmax(nd.array(x)), sm, rtol=1e-5)
+    assert_almost_equal(nd.log_softmax(nd.array(x)), onp.log(sm), rtol=1e-4)
+    # masked softmax with valid length
+    length = onp.array([2, 5, 3])
+    out = nd.softmax(nd.array(x), length=nd.array(length), axis=-1)
+    o = out.asnumpy()
+    assert abs(o[0, :2].sum() - 1) < 1e-5
+    assert o[0, 2:].sum() < 1e-6
+
+
+def test_layer_norm_op():
+    x = _r(4, 6)
+    g = _r(6)
+    b = _r(6)
+    out = nd.layer_norm(nd.array(x), nd.array(g), nd.array(b))
+    mu = x.mean(-1, keepdims=True)
+    sig = x.std(-1, keepdims=True)
+    assert_almost_equal(out, (x - mu) / onp.sqrt(sig ** 2 + 1e-5) * g + b,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_inference():
+    x = _r(2, 3, 4, 4)
+    gamma = onp.abs(_r(3)) + 0.5
+    beta = _r(3)
+    mean = _r(3)
+    var = onp.abs(_r(3)) + 0.5
+    out, _, _ = nd.batch_norm(nd.array(x), nd.array(gamma), nd.array(beta),
+                              nd.array(mean), nd.array(var), fix_gamma=False,
+                              eps=1e-3)
+    expect = ((x - mean[None, :, None, None])
+              / onp.sqrt(var[None, :, None, None] + 1e-3)
+              * gamma[None, :, None, None] + beta[None, :, None, None])
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_numeric():
+    check_numeric_gradient(lambda x: (x * x).sum(), [_r(3)])
+    check_numeric_gradient(lambda x: nd.tanh(x).sum(), [_r(3)])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b).sum(), [_r(2, 3), _r(3, 2)])
+    check_numeric_gradient(lambda x: nd.softmax(x).sum(axis=0).max(), [_r(2, 3)])
+
+
+def test_take_pick_gather():
+    x = onp.arange(12).reshape(3, 4).astype(onp.float32)
+    assert_almost_equal(nd.take(nd.array(x), nd.array([0, 2])), x[[0, 2]])
+    picked = nd.pick(nd.array(x), nd.array([1, 0, 3]), axis=1)
+    assert_almost_equal(picked, [1, 4, 11])
+    gnd = nd.gather_nd(nd.array(x), nd.array([[0, 2], [1, 3]]))
+    assert_almost_equal(gnd, [x[0, 1], x[2, 3]])
+    snd = nd.scatter_nd(nd.array([9., 8.]), nd.array([[0, 2], [1, 3]]),
+                        shape=(3, 4))
+    expect = onp.zeros((3, 4)); expect[0, 1] = 9; expect[2, 3] = 8
+    assert_almost_equal(snd, expect)
+
+
+def test_sequence_ops():
+    x = onp.arange(24).reshape(4, 3, 2).astype(onp.float32)  # (T, N, C)
+    length = onp.array([2, 4, 3], onp.float32)
+    masked = nd.sequence_mask(nd.array(x), nd.array(length),
+                              use_sequence_length=True, value=-1)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == -1).all() and (m[:2, 0] == x[:2, 0]).all()
+    last = nd.sequence_last(nd.array(x), nd.array(length),
+                            use_sequence_length=True)
+    assert_almost_equal(last, onp.stack([x[1, 0], x[3, 1], x[2, 2]]))
+    rev = nd.sequence_reverse(nd.array(x), nd.array(length),
+                              use_sequence_length=True)
+    r = rev.asnumpy()
+    assert_almost_equal(r[:2, 0], x[:2, 0][::-1])
+    assert_almost_equal(r[2:, 0], x[2:, 0])
+
+
+def test_elemwise_misc():
+    x = _r(3, 3)
+    assert_almost_equal(nd.clip(nd.array(x), -0.5, 0.5), onp.clip(x, -0.5, 0.5))
+    assert_almost_equal(nd.where(nd.array((x > 0).astype(onp.float32)),
+                                 nd.array(x), nd.array(-x)), onp.abs(x))
+    assert_almost_equal(nd.add_n(nd.array(x), nd.array(x), nd.array(x)), 3 * x,
+                        rtol=1e-6)
+    assert_almost_equal(nd.cast(nd.array(x), dtype='int32'),
+                        x.astype(onp.int32))
+    out = nd.smooth_l1(nd.array(x), scalar=1.0)
+    expect = onp.where(onp.abs(x) < 1, 0.5 * x ** 2, onp.abs(x) - 0.5)
+    assert_almost_equal(out, expect, rtol=1e-5)
+
+
+def test_linalg_ops():
+    a = _r(3, 3)
+    spd = a.dot(a.T) + 3 * onp.eye(3, dtype=onp.float32)
+    from mxnet_tpu.ndarray import linalg
+    chol = linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(chol.dot(chol.T), spd, rtol=1e-4)
+    assert_almost_equal(linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True),
+                        a.dot(a.T), rtol=1e-5)
+    assert_almost_equal(linalg.syrk(nd.array(a)), a.dot(a.T), rtol=1e-5)
+    assert_almost_equal(linalg.extractdiag(nd.array(spd)), onp.diag(spd))
+    det = linalg.det(nd.array(spd)).asscalar()
+    assert abs(det - onp.linalg.det(spd)) / abs(det) < 1e-4
+
+
+def test_rnn_op_lstm_shapes_and_grad():
+    T, N, I, H = 5, 2, 3, 4
+    x = nd.array(_r(T, N, I))
+    ngates = 4
+    nparams = ngates * H * I + ngates * H * H + 2 * ngates * H
+    params = nd.array(_r(nparams))
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    x.attach_grad()
+    params.attach_grad()
+    with autograd.record():
+        out, hT, cT = nd.rnn(x, params, h0, c0, state_size=H, num_layers=1,
+                             mode='lstm')
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (T, N, H)
+    assert hT.shape == (1, N, H)
+    assert float(onp.abs(params.grad.asnumpy()).sum()) > 0
+
+
+def test_ctc_loss_simple():
+    # trivial case: T=2, single label, compare against hand-computed
+    import torch
+    import torch.nn.functional as F
+    T, N, C = 6, 2, 5
+    logits = _r(T, N, C)
+    labels = onp.array([[1, 2, -1, -1], [3, -1, -1, -1]], onp.float32)
+    loss = nd.ctc_loss(nd.array(logits), nd.array(labels))
+    tlabels = torch.tensor([[1, 2], [3, 0]], dtype=torch.long)
+    tlens = torch.tensor([2, 1])
+    ref = F.ctc_loss(torch.tensor(logits).log_softmax(-1), tlabels,
+                     torch.tensor([T, T]), tlens, blank=0, reduction='none')
+    assert_almost_equal(loss, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_box_iou_and_nms():
+    boxes = onp.array([[0, 0, 2, 2], [1, 1, 3, 3], [10, 10, 12, 12]],
+                      onp.float32)
+    iou = nd.box_iou(nd.array(boxes), nd.array(boxes)).asnumpy()
+    assert abs(iou[0, 1] - 1.0 / 7.0) < 1e-5
+    assert iou[0, 2] == 0
+    # NMS: data (N, 6) = [cls, score, x1, y1, x2, y2]
+    dets = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 1, 1, 3, 3],
+        [0, 0.7, 10, 10, 12, 12],
+    ], onp.float32)
+    out = nd.box_nms(nd.array(dets), overlap_thresh=0.1, coord_start=2,
+                     score_index=1, id_index=0).asnumpy()
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 2  # middle box suppressed
+
+
+def test_attention_ops():
+    T, N, H, D = 4, 2, 2, 3
+    qkv = _r(T, N, 3 * H * D)
+    scores = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert scores.shape == (N * H, T, T)
+    att = nd.softmax(scores, axis=-1)
+    out = nd.interleaved_matmul_selfatt_valatt(nd.array(qkv), att, heads=H)
+    assert out.shape == (T, N, H * D)
+    # fused MHA equals naive
+    q = _r(N, T, H * D)
+    k = _r(N, T, H * D)
+    v = _r(N, T, H * D)
+    fused = nd.multi_head_attention(nd.array(q), nd.array(k), nd.array(v),
+                                    num_heads=H, use_pallas=False)
+    qh = q.reshape(N, T, H, D).transpose(0, 2, 1, 3)
+    kh = k.reshape(N, T, H, D).transpose(0, 2, 1, 3)
+    vh = v.reshape(N, T, H, D).transpose(0, 2, 1, 3)
+    s = onp.einsum('nhqd,nhkd->nhqk', qh, kh) / onp.sqrt(D)
+    p = onp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = onp.einsum('nhqk,nhkd->nhqd', p, vh).transpose(0, 2, 1, 3).reshape(N, T, H * D)
+    assert_almost_equal(fused, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_matches_naive():
+    from mxnet_tpu.ops.pallas_attention import flash_attention
+    import jax.numpy as jnp
+    B, H, T, D = 2, 2, 16, 4
+    q = jnp.asarray(_r(B, H, T, D))
+    k = jnp.asarray(_r(B, H, T, D))
+    v = jnp.asarray(_r(B, H, T, D))
+    out = flash_attention(q, k, v, block_k=8)
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) / onp.sqrt(D)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = jnp.einsum('bhqk,bhkd->bhqd', p, v)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=1e-4,
+                        atol=1e-5)
+    out_c = flash_attention(q, k, v, causal=True, block_k=8)
+    mask = onp.tril(onp.ones((T, T), bool))
+    s2 = onp.asarray(s)
+    s2 = onp.where(mask, s2, -1e30)
+    p2 = onp.exp(s2 - s2.max(-1, keepdims=True))
+    p2 = p2 / p2.sum(-1, keepdims=True)
+    ref_c = onp.einsum('bhqk,bhkd->bhqd', p2, onp.asarray(v))
+    assert_almost_equal(onp.asarray(out_c), ref_c, rtol=1e-4, atol=1e-5)

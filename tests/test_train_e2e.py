@@ -1,0 +1,154 @@
+"""End-to-end training: the SURVEY §7 step-4 milestone — LeNet on synthetic
+MNIST converges (ref: example/gluon/mnist + tests/python/train)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.models import LeNet
+
+
+def _toy_problem(n=256, d=10, classes=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    w = rng.randn(d, classes).astype(onp.float32)
+    x = rng.randn(n, d).astype(onp.float32)
+    y = (x.dot(w) + 0.1 * rng.randn(n, classes)).argmax(axis=1)
+    return x, y.astype(onp.float32)
+
+
+def _accuracy(net, x, y):
+    out = net(nd.array(x)).asnumpy()
+    return float((out.argmax(axis=1) == y).mean())
+
+
+def test_mlp_converges_sgd():
+    x, y = _toy_problem()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu'))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5, 'momentum': 0.9})
+    batch = 64
+    for epoch in range(15):
+        for i in range(0, len(x), batch):
+            xb = nd.array(x[i:i + batch])
+            yb = nd.array(y[i:i + batch])
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(batch)
+    assert _accuracy(net, x, y) > 0.9
+
+
+def test_mlp_converges_hybridized_adam():
+    x, y = _toy_problem(seed=1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu'))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    batch = 64
+    for epoch in range(15):
+        for i in range(0, len(x), batch):
+            xb = nd.array(x[i:i + batch])
+            yb = nd.array(y[i:i + batch])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(batch)
+    assert _accuracy(net, x, y) > 0.9
+
+
+def test_lenet_one_epoch_mnist_synthetic():
+    """LeNet runs fwd/bwd/step on MNIST-shaped data and loss decreases."""
+    rng = onp.random.RandomState(0)
+    n = 64
+    x = rng.rand(n, 1, 28, 28).astype(onp.float32)
+    # make labels learnable: class = quadrant with most mass
+    y = (x.mean(axis=(1, 2, 3)) > 0.5).astype(onp.float32)
+    net = LeNet(classes=2)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    losses = []
+    for epoch in range(8):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y))
+        loss.backward()
+        trainer.step(n)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_estimator_fit():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+    x, y = _toy_problem(n=128)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=trainer, context=[mx.cpu()])
+    loader = DataLoader(ArrayDataset(x, y), batch_size=32)
+    est.fit(loader, epochs=3)
+    assert _accuracy(net, x, y) > 0.5
+
+
+def test_trainer_save_load_states(tmp_path):
+    x, y = _toy_problem(n=64)
+    net = nn.Dense(3, in_units=10)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(nd.array(x)), nd.array(y))
+    loss.backward()
+    trainer.step(64)
+    fname = str(tmp_path / 'trainer.states')
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+    with autograd.record():
+        loss = loss_fn(net(nd.array(x)), nd.array(y))
+    loss.backward()
+    trainer.step(64)
+
+
+def test_multi_device_data_parallel():
+    """DP across several logical devices in one process (SURVEY §4:
+    multi-device without cluster)."""
+    import jax
+    ndev = min(4, len(jax.devices()))
+    if ndev < 2:
+        return
+    ctxs = [mx.Context('cpu', i) for i in range(ndev)]
+    x, y = _toy_problem(n=128)
+    net = nn.Dense(3, in_units=10)
+    net.initialize(mx.init.Xavier(), ctx=ctxs)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5, 'momentum': 0.9},
+                            kvstore='device')
+    batch = 64
+    for epoch in range(15):
+        for i in range(0, len(x), batch):
+            xs = gluon.split_and_load(nd.array(x[i:i + batch]), ctxs)
+            ys = gluon.split_and_load(nd.array(y[i:i + batch]), ctxs)
+            with autograd.record():
+                losses = [loss_fn(net(xb), yb) for xb, yb in zip(xs, ys)]
+            for l in losses:
+                l.backward()
+            trainer.step(batch)
+    acc = _accuracy(net, x, y)
+    assert acc > 0.8
