@@ -1,0 +1,68 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Reference baseline: 109 images/sec training ResNet-50, batch 32, 1x K80
+(example/image-classification/README.md:154). vs_baseline = ours / 109.
+
+The whole train step (fwd+bwd+SGD update) is one compiled XLA program via
+ShardedTrainStep — the framework's hot path. Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as onp
+
+
+def main():
+    import jax
+
+    on_accel = any(d.platform != 'cpu' for d in jax.devices())
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+
+    if on_accel:
+        batch, img, steps, warmup = 64, 224, 10, 3
+        devices = [d for d in jax.devices() if d.platform != 'cpu']
+    else:
+        # smoke-scale on CPU so the script stays runnable anywhere
+        batch, img, steps, warmup = 8, 64, 3, 1
+        devices = jax.devices()
+
+    mesh = make_mesh((len(devices),), ('dp',), devices=devices)
+
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss_fn, 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9},
+                            mesh=mesh)
+
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+    y = nd.array(rng.randint(0, 1000, batch).astype(onp.float32))
+
+    for _ in range(warmup):
+        step(x, y).wait_to_read()
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss.wait_to_read()
+    dt = time.time() - t0
+
+    ips = batch * steps / dt
+    ips_per_chip = ips / len(devices)
+    baseline = 109.0  # reference resnet-50 images/sec (1x K80, batch 32)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / baseline, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -33,9 +33,11 @@ class TapeNode:
 class _Tape(threading.local):
     def __init__(self):
         self.nodes: List[TapeNode] = []
+        self.retained = False  # a retain_graph backward keeps nodes alive
 
     def clear(self):
         self.nodes = []
+        self.retained = False
 
 
 tape = _Tape()
@@ -215,7 +217,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if id(h) not in seen and h._grad is not None and id(h) in grad_map:
             _write_grad(h, grad_map[id(h)])
 
-    if not retain_graph:
+    if retain_graph:
+        tape.retained = True
+    else:
         consumed = set(map(id, nodes))
         tape.nodes = [n for n in tape.nodes if id(n) not in consumed]
 
@@ -261,6 +265,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         if g is None:
             g = _wrap(jnp.zeros_like(v._data))
         results.append(g)
-    if not retain_graph:
+    if retain_graph:
+        tape.retained = True
+    else:
         tape.clear()
     return results[0] if single else results

@@ -20,13 +20,17 @@ class _RecordingStateScope:
     def __enter__(self):
         if self._enter_is_record is not None:
             self._prev_is_record = state.is_recording
-            # entering a fresh top-level record scope drops stale nodes left
-            # by heads that were never backwarded (selective pruning in
-            # backward() keeps non-ancestor nodes alive; without this, a
-            # training loop recording auxiliary outputs would grow the tape
-            # — and pin device memory — unboundedly)
-            if self._enter_is_record and not state.is_recording:
-                _imperative.tape.clear()
+            if self._enter_is_record:
+                # entering a fresh top-level record scope drops stale nodes
+                # left by heads that were never backwarded (selective pruning
+                # in backward() keeps non-ancestor nodes alive; without this,
+                # a training loop recording auxiliary outputs would grow the
+                # tape — and pin device memory — unboundedly). Guarded so a
+                # nested/paused scope or a retain_graph'd graph is untouched.
+                if state.record_depth == 0 and not state.is_recording \
+                        and not _imperative.tape.retained:
+                    _imperative.tape.clear()
+                state.record_depth += 1
             state.is_recording = self._enter_is_record
         if self._enter_train_mode is not None:
             self._prev_train_mode = state.is_training
@@ -35,6 +39,8 @@ class _RecordingStateScope:
 
     def __exit__(self, *exc):
         if self._enter_is_record is not None:
+            if self._enter_is_record:
+                state.record_depth -= 1
             state.is_recording = self._prev_is_record
         if self._enter_train_mode is not None:
             state.is_training = self._prev_train_mode

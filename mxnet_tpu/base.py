@@ -107,6 +107,7 @@ class _ThreadLocalState(threading.local):
         self.is_recording = False
         self.is_training = False
         self.is_deferred_compute = False
+        self.record_depth = 0  # nesting depth of autograd.record scopes
 
 
 state = _ThreadLocalState()

@@ -408,8 +408,7 @@ class CachedOp:
                 proxy._data = new_val
             else:
                 for d in p._data:
-                    d._data = jax.device_put(new_val,
-                                             list(d._data.devices())[0])
+                    d._data = jax.device_put(new_val, d._data.sharding)
 
         out_arrs = [_wrap(o) for o in outs_flat]
         if vjp_fn is not None:

@@ -161,6 +161,10 @@ class Parameter:
         for i, c in enumerate(self._ctx_list):
             if c == ctx:
                 return i
+        if len(self._data) == 1:
+            # single copy serves every context (it may be mesh-sharded and
+            # thus not owned by any single logical device)
+            return 0
         raise MXNetError(f"Parameter '{self.name}' was not initialized on "
                          f"context {ctx}; it is on {self._ctx_list}")
 
@@ -210,7 +214,9 @@ class Parameter:
         if src.dtype != self._data[0]._data.dtype:
             src = src.astype(self._data[0]._data.dtype)
         for d in self._data:
-            d._data = jax.device_put(src, list(d._data.devices())[0])
+            # preserve each copy's placement/sharding (a single copy may be
+            # mesh-sharded after a pjit step — don't gather it to one device)
+            d._data = jax.device_put(src, d._data.sharding)
         return self
 
     def zero_grad(self):

@@ -156,7 +156,7 @@ class Trainer:
             self._updater(i, g, datas[0])
             src = datas[0]._data
             for d in datas[1:]:
-                d._data = jax.device_put(src, list(d._data.devices())[0])
+                d._data = jax.device_put(src, d._data.sharding)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -33,6 +33,17 @@ def _dev_of(ctx):
     return (ctx or current_context()).jax_device()
 
 
+def _maybe_put(data, ctx):
+    """Commit to a device only when the user named a context (directly or
+    via a `with ctx:` scope); uncommitted arrays follow their consumers'
+    sharding, so eager math composes with mesh-sharded parameters after a
+    pjit training step."""
+    from ..context import _DEFAULT
+    if ctx is None and Context.default_ctx() is _DEFAULT:
+        return data
+    return jax.device_put(data, _dev_of(ctx))
+
+
 class NDArray:
     __slots__ = ('_data', '_ctx', '_grad', '_grad_req', '_in_graph',
                  '_stype', '__weakref__')
@@ -470,7 +481,7 @@ def array(source_array, ctx=None, dtype=None) -> NDArray:
         arr = arr.astype(onp.float32)
     if arr.dtype == onp.int64 and dtype is None:
         arr = arr.astype(onp.int32)
-    data = jax.device_put(jnp.asarray(arr), _dev_of(ctx))
+    data = _maybe_put(jnp.asarray(arr), ctx)
     return NDArray(data, ctx)
 
 
@@ -479,17 +490,17 @@ def empty(shape, ctx=None, dtype='float32') -> NDArray:
 
 
 def zeros(shape, ctx=None, dtype='float32', **kwargs) -> NDArray:
-    data = jax.device_put(jnp.zeros(shape, _to_jax_dtype(dtype)), _dev_of(ctx))
+    data = _maybe_put(jnp.zeros(shape, _to_jax_dtype(dtype)), ctx)
     return NDArray(data, ctx)
 
 
 def ones(shape, ctx=None, dtype='float32', **kwargs) -> NDArray:
-    data = jax.device_put(jnp.ones(shape, _to_jax_dtype(dtype)), _dev_of(ctx))
+    data = _maybe_put(jnp.ones(shape, _to_jax_dtype(dtype)), ctx)
     return NDArray(data, ctx)
 
 
 def full(shape, val, ctx=None, dtype='float32') -> NDArray:
-    data = jax.device_put(jnp.full(shape, val, _to_jax_dtype(dtype)), _dev_of(ctx))
+    data = _maybe_put(jnp.full(shape, val, _to_jax_dtype(dtype)), ctx)
     return NDArray(data, ctx)
 
 

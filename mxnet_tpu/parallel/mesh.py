@@ -27,10 +27,10 @@ def make_mesh(axis_shapes: Sequence[int] = None,
     total = 1
     for s in axis_shapes:
         total *= s
-    if total != n:
+    if total > n:
         raise ValueError(f"mesh {tuple(axis_shapes)} needs {total} devices, "
                          f"have {n}")
-    dev_array = onp.array(devices).reshape(tuple(axis_shapes))
+    dev_array = onp.array(devices[:total]).reshape(tuple(axis_shapes))
     return Mesh(dev_array, tuple(axis_names))
 
 

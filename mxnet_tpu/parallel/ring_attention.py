@@ -76,6 +76,9 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
         acc_out = jnp.zeros(q_blk.shape, jnp.float32)
         acc_m = jnp.full(q_blk.shape[:3] + (1,), -jnp.inf, jnp.float32)
         acc_l = jnp.zeros(q_blk.shape[:3] + (1,), jnp.float32)
+        # initial accumulators are constants; mark them as varying over the
+        # ring axis so the scan carry type matches the per-shard outputs
+        acc_out, acc_m, acc_l = lax.pcast((acc_out, acc_m, acc_l), sp_axis, to='varying')
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -97,4 +100,4 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
         return (acc_out / jnp.maximum(acc_l, 1e-30)).astype(q_blk.dtype)
 
     return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec)(q, k, v)
