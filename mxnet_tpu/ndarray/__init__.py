@@ -11,6 +11,7 @@ from . import register as _register
 from . import random      # noqa: F401
 from . import linalg      # noqa: F401
 from . import sparse      # noqa: F401
+from . import contrib     # noqa: F401
 from .utils import split_data, split_and_load  # noqa: F401
 
 # populate module namespace with op wrappers (skip names already defined,

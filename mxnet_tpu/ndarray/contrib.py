@@ -1,0 +1,18 @@
+"""`mx.nd.contrib` namespace (ref: python/mxnet/ndarray/contrib.py).
+
+Control-flow higher-order ops plus the contrib op library (box_nms,
+roi_align, multibox_prior, interleaved_matmul attention kernels, ...),
+matching the reference's `mx.nd.contrib.*` surface — only ops registered
+from the contrib/attention modules, not the whole registry.
+"""
+from ..base import _OP_REGISTRY
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from ..ops import contrib as _contrib_ops
+from ..ops import attention as _attention_ops
+from .register import make_wrapper as _make_wrapper
+
+_CONTRIB_MODULES = (_contrib_ops.__name__, _attention_ops.__name__)
+for _name, _opdef in _OP_REGISTRY.items():
+    if getattr(_opdef.fn, '__module__', None) in _CONTRIB_MODULES:
+        globals()[_name] = _make_wrapper(_opdef)
+del _name, _opdef

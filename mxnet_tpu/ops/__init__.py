@@ -17,6 +17,7 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence    # noqa: F401
 from . import attention   # noqa: F401
 from . import contrib     # noqa: F401
+from . import control_flow  # noqa: F401
 
 from .elemwise import *     # noqa: F401,F403
 from .reduce import *       # noqa: F401,F403

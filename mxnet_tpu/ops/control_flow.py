@@ -1,0 +1,274 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+TPU-native analog of the reference's subgraph-executing higher-order ops
+(ref: src/operator/control_flow.cc:1089,1150,1211 — `_foreach`,
+`_while_loop`, `_cond` — and the imperative frontends in
+python/mxnet/ndarray/contrib.py). The reference runs a captured nnvm
+subgraph per iteration; here the body is traced once into a
+`lax.scan`/`lax.while_loop`/`lax.cond` so XLA compiles the whole loop as a
+single program with static shapes — the idiomatic TPU formulation.
+
+Gradients:
+- `foreach` records ONE tape node whose vjp is `jax.vjp` over the whole
+  scan (reverse-mode through `lax.scan` is native in XLA).
+- eager `while_loop`/`cond` execute ops through the normal imperative
+  path, so the autograd tape records every iteration (mirrors the
+  reference's imperative fallback in python/mxnet/ndarray/contrib.py).
+- traced `while_loop` lowers to a masked fixed-length scan
+  (`max_iterations` steps with a live flag) so it stays reverse-mode
+  differentiable — `lax.while_loop` itself is not.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+__all__ = ['foreach', 'while_loop', 'cond']
+
+
+def _is_nd(x):
+    from ..ndarray.ndarray import NDArray
+    return isinstance(x, NDArray)
+
+
+def _flatten(tree):
+    """Flatten a nested list/tuple of NDArrays into (leaves, treedef)."""
+    leaves, treedef = tree_util.tree_flatten(tree, is_leaf=_is_nd)
+    return leaves, treedef
+
+
+def _leaf_data(leaves):
+    return [x._data if _is_nd(x) else jnp.asarray(x) for x in leaves]
+
+
+def _wrap_tree(treedef, datas):
+    from ..ndarray.ndarray import NDArray
+    return tree_util.tree_unflatten(treedef, [NDArray(d) for d in datas])
+
+
+def _paused(fn):
+    """Run fn with tape recording off (the subgraph is differentiated as a
+    whole by jax, not op-by-op on the tape)."""
+    from ..base import state
+
+    def run(*a, **kw):
+        prev = state.is_recording
+        state.is_recording = False
+        try:
+            return fn(*a, **kw)
+        finally:
+            state.is_recording = prev
+    return run
+
+
+def _any_tracer(datas):
+    return any(isinstance(d, jax.core.Tracer) for d in datas)
+
+
+def foreach(body, data, init_states):
+    """Scan `body` over the leading axis of `data`.
+
+    body(data_slice, states) -> (outputs, new_states). Returns
+    (stacked_outputs, final_states). Ref: control_flow.cc:1089 `_foreach`;
+    lowered to one `lax.scan` (compiler-scheduled, MXU-friendly).
+
+    When the autograd tape is recording we instead run a Python loop through
+    the imperative path (mirroring python/mxnet/ndarray/contrib.py foreach):
+    the scan formulation differentiates only the explicit data/state inputs,
+    so parameters the body closes over (the standard RNN-cell pattern) would
+    silently get zero gradients. Inside a jit/hybridize trace the whole
+    program is differentiated by jax, so scan is used there.
+    """
+    from ..base import state as _state
+    from ..ndarray.ndarray import _invoke
+
+    data_leaves, data_def = _flatten(data)
+    state_leaves, state_def = _flatten(init_states)
+    n_data = len(data_leaves)
+    out_struct = {}
+
+    if _state.is_recording and not _any_tracer(_leaf_data(data_leaves)):
+        states = init_states
+        outputs = []
+        length = data_leaves[0].shape[0]
+        for t in range(length):
+            slice_tree = tree_util.tree_unflatten(
+                data_def, [d[t] for d in data_leaves])
+            out, states = body(slice_tree, states)
+            outputs.append(out)
+        from . import matrix as _mat
+        out_leaf_lists = [_flatten(o)[0] for o in outputs]
+        out_def = _flatten(outputs[0])[1]
+        stacked = [_invoke(_mat.stack, *[ol[i] for ol in out_leaf_lists])
+                   for i in range(len(out_leaf_lists[0]))]
+        return tree_util.tree_unflatten(out_def, stacked), states
+
+    run_body = _paused(body)
+
+    def g(*arrs):
+        xs = arrs[:n_data]
+        carry0 = arrs[n_data:]
+
+        def step(carry, x):
+            d_tree = _wrap_tree(data_def, x)
+            s_tree = _wrap_tree(state_def, carry)
+            outs, new_states = run_body(d_tree, s_tree)
+            out_leaves, out_def = _flatten(outs)
+            ns_leaves, _ = _flatten(new_states)
+            out_struct['out_def'] = out_def
+            out_struct['n_out'] = len(out_leaves)
+            return tuple(_leaf_data(ns_leaves)), tuple(_leaf_data(out_leaves))
+
+        final, ys = jax.lax.scan(step, tuple(carry0), tuple(xs))
+        return tuple(ys) + tuple(final)
+
+    res = _invoke(g, *(data_leaves + state_leaves))
+    if not isinstance(res, tuple):
+        res = (res,)
+    n_out = out_struct['n_out']
+    outs = tree_util.tree_unflatten(out_struct['out_def'], list(res[:n_out]))
+    states = tree_util.tree_unflatten(state_def, list(res[n_out:]))
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run `func` while `cond(loop_vars)` is true.
+
+    func(loop_vars) -> (step_output, new_loop_vars); returns
+    (stacked_outputs, final_loop_vars). Ref: control_flow.cc:1150
+    `_while_loop` + python/mxnet/ndarray/contrib.py while_loop.
+
+    Eager: a Python loop through the imperative path (tape-differentiable,
+    unbounded unless max_iterations given); outputs are zero-padded to
+    max_iterations when it is given, matching the reference and the traced
+    path. Zero executed iterations returns [] for outputs (as the
+    reference's imperative frontend does). Traced: a masked fixed-length
+    `lax.scan` over max_iterations — reverse-differentiable, static shapes.
+    """
+    from ..ndarray.ndarray import _invoke
+    from . import matrix as _mat
+
+    lv_leaves, lv_def = _flatten(loop_vars)
+    if _any_tracer(_leaf_data(lv_leaves)):
+        if max_iterations is None:
+            raise ValueError("while_loop under trace requires max_iterations")
+        return _while_loop_traced(cond, func, loop_vars, max_iterations)
+
+    steps = 0
+    outputs = []
+    while bool(_as_scalar(cond(loop_vars))):
+        out, loop_vars = func(loop_vars)
+        outputs.append(out)
+        steps += 1
+        if max_iterations is not None and steps >= max_iterations:
+            break
+    if not outputs:
+        return [], loop_vars
+    out_leaf_lists = [_flatten(o)[0] for o in outputs]
+    out_def = _flatten(outputs[0])[1]
+    pad = (max_iterations - steps) if max_iterations is not None else 0
+    stacked = []
+    for i in range(len(out_leaf_lists[0])):
+        parts = [ol[i] for ol in out_leaf_lists]
+        s = _invoke(_mat.stack, *parts)
+        if pad:
+            s = _invoke(lambda x, n=pad: jnp.concatenate(
+                [x, jnp.zeros((n,) + x.shape[1:], x.dtype)]), s)
+        stacked.append(s)
+    return tree_util.tree_unflatten(out_def, stacked), loop_vars
+
+
+def _while_loop_traced(cond, func, loop_vars, max_iterations):
+    from ..ndarray.ndarray import _invoke
+
+    lv_leaves, lv_def = _flatten(loop_vars)
+    out_struct = {}
+    run_cond = _paused(cond)
+    run_func = _paused(func)
+
+    def g(*arrs):
+        def step(carry, _):
+            alive, lv = carry
+            lv_tree = _wrap_tree(lv_def, lv)
+            pred = _leaf_data(_flatten(run_cond(lv_tree))[0])[0]
+            alive_now = jnp.logical_and(alive, pred.astype(bool).reshape(()))
+            out, new_lv = run_func(lv_tree)
+            out_leaves, out_def = _flatten(out)
+            nl_leaves, _ = _flatten(new_lv)
+            out_struct['out_def'] = out_def
+            out_struct['n_out'] = len(out_leaves)
+            new_data = _leaf_data(nl_leaves)
+            kept = tuple(jnp.where(alive_now, n, o)
+                         for n, o in zip(new_data, lv))
+            outs = tuple(jnp.where(alive_now, o, jnp.zeros_like(o))
+                         for o in _leaf_data(out_leaves))
+            return (alive_now, kept), outs
+
+        (alive, final), ys = jax.lax.scan(
+            step, (jnp.bool_(True), tuple(arrs)), None,
+            length=max_iterations)
+        return tuple(ys) + tuple(final)
+
+    res = _invoke(g, *lv_leaves)
+    if not isinstance(res, tuple):
+        res = (res,)
+    n_out = out_struct['n_out']
+    outs = tree_util.tree_unflatten(out_struct['out_def'], list(res[:n_out]))
+    final = tree_util.tree_unflatten(lv_def, list(res[n_out:]))
+    return outs, final
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Branch on a scalar predicate. Ref: control_flow.cc:1211 `_cond`.
+
+    Eager: evaluates the predicate on host and runs one branch through the
+    imperative path (tape-differentiable). Traced (pass `inputs`, the
+    NDArrays the branches close over): lowers to `lax.cond`.
+    """
+    from ..ndarray.ndarray import _invoke
+
+    pred_data = pred._data if _is_nd(pred) else jnp.asarray(pred)
+    if inputs is None and not isinstance(pred_data, jax.core.Tracer):
+        return then_func() if bool(_as_scalar(pred)) else else_func()
+
+    in_leaves, in_def = _flatten(inputs if inputs is not None else [])
+    out_struct = {}
+    branches = [(_paused(then_func), _expects_arg(then_func)),
+                (_paused(else_func), _expects_arg(else_func))]
+
+    def g(p, *arrs):
+        def branch(fn, takes_arg):
+            def run(ops):
+                wrapped = _wrap_tree(in_def, ops)
+                outs = fn(wrapped) if takes_arg else fn()
+                leaves, out_def = _flatten(outs)
+                out_struct['out_def'] = out_def
+                return tuple(_leaf_data(leaves))
+            return run
+        return jax.lax.cond(p.astype(bool).reshape(()),
+                            branch(*branches[0]), branch(*branches[1]),
+                            tuple(arrs))
+
+    res = _invoke(g, pred if _is_nd(pred) else jnp.asarray(pred), *in_leaves)
+    if not isinstance(res, tuple):
+        res = (res,)
+    return tree_util.tree_unflatten(out_struct['out_def'], list(res))
+
+
+def _expects_arg(fn):
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len([p for p in sig.parameters.values()
+                if p.default is p.empty
+                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]) > 0
+
+
+def _as_scalar(x):
+    if _is_nd(x):
+        return x.asnumpy().reshape(()).item() if hasattr(x, 'asnumpy') \
+            else x._data.reshape(()).item()
+    return jnp.asarray(x).reshape(()).item()

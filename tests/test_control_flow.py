@@ -1,0 +1,190 @@
+"""Control-flow op tests (ref: tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_foreach_cumsum():
+    data = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, s):
+        out = x + s
+        return out, out
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    expect = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_multi_state_grad():
+    data = nd.array(onp.random.RandomState(0).rand(5, 2).astype(onp.float32))
+    data.attach_grad()
+    init = nd.ones((2,))
+
+    def body(x, s):
+        new_s = s * x
+        return new_s, new_s
+
+    with autograd.record():
+        outs, final = nd.contrib.foreach(body, data, init)
+        loss = outs.sum() + final.sum()
+    loss.backward()
+    # numerical check
+    d = data.asnumpy()
+    eps = 1e-3
+    g = data.grad.asnumpy()
+    for i in range(5):
+        for j in range(2):
+            dp, dm = d.copy(), d.copy()
+            dp[i, j] += eps
+            dm[i, j] -= eps
+
+            def f(arr):
+                s = onp.ones(2)
+                tot = 0.0
+                for r in arr:
+                    s = s * r
+                    tot += s.sum()
+                return tot + s.sum()
+            num = (f(dp) - f(dm)) / (2 * eps)
+            assert abs(num - g[i, j]) < 1e-2, (i, j, num, g[i, j])
+
+
+def test_while_loop_eager():
+    def cond(lv):
+        i, _ = lv
+        return i < 5
+
+    def func(lv):
+        i, total = lv
+        return total + i, (i + 1, total + i)
+
+    outs, (i, total) = nd.contrib.while_loop(
+        cond, func, (nd.array([0.0]), nd.array([0.0])), max_iterations=10)
+    assert int(i.asnumpy()[0]) == 5
+    assert float(total.asnumpy()[0]) == 0 + 1 + 2 + 3 + 4
+    # padded to max_iterations along axis 0 (ref: ndarray/contrib.py:271)
+    assert outs.shape[0] == 10
+    onp.testing.assert_allclose(outs.asnumpy()[5:], 0.0)
+
+
+def test_while_loop_eager_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+
+    def cond(lv):
+        i, _ = lv
+        return i < 3
+
+    def func(lv):
+        i, acc = lv
+        return acc * x, (i + 1, acc * x)
+
+    with autograd.record():
+        outs, (_, acc) = nd.contrib.while_loop(
+            cond, func, (nd.array([0.0]), nd.ones((1,))))
+        loss = acc.sum()
+    loss.backward()
+    # acc = x^3, d/dx = 3x^2 = 12
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_while_loop_traced_matches_eager():
+    import jax
+
+    def cond(lv):
+        i, _ = lv
+        return i < 4
+
+    def func(lv):
+        i, s = lv
+        return s + i, (i + 1, s + i)
+
+    outs_e, (ie, se) = nd.contrib.while_loop(
+        cond, func, (nd.array([0.0]), nd.array([1.0])), max_iterations=6)
+    # eager outputs padded to max_iterations like the reference
+    assert outs_e.shape[0] == 6
+    onp.testing.assert_allclose(outs_e.asnumpy()[4:], 0.0)
+
+    def traced(i0, s0):
+        outs, (i, s) = nd.contrib.while_loop(
+            cond, func, (nd._wrap(i0), nd._wrap(s0)), max_iterations=6)
+        return outs._data, i._data, s._data
+
+    o_t, i_t, s_t = jax.jit(traced)(onp.zeros(1, onp.float32),
+                                    onp.ones(1, onp.float32))
+    onp.testing.assert_allclose(onp.asarray(i_t), ie.asnumpy())
+    onp.testing.assert_allclose(onp.asarray(s_t), se.asnumpy())
+    onp.testing.assert_allclose(onp.asarray(o_t), outs_e.asnumpy())
+
+
+def test_foreach_closure_param_grad():
+    """Parameters the body closes over must receive gradients (RNN-cell
+    pattern; the scan formulation would silently drop them)."""
+    w = nd.array([2.0, 3.0])
+    w.attach_grad()
+    data = nd.array(onp.ones((3, 2), onp.float32))
+
+    def body(x, s):
+        out = x * w + s
+        return out, out
+
+    with autograd.record():
+        outs, final = nd.contrib.foreach(body, data, nd.zeros((2,)))
+        loss = final.sum()
+    loss.backward()
+    # final = 3*w (elementwise over 3 unit inputs): d/dw = 3
+    onp.testing.assert_allclose(w.grad.asnumpy(), [3.0, 3.0], rtol=1e-6)
+
+
+def test_cond_eager_and_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.contrib.cond(x.sum() > 0, lambda: x * 2, lambda: x * 5)
+        out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+    y = nd.array([-1.0])
+    out = nd.contrib.cond(y.sum() > 0, lambda: y * 2, lambda: y * 5)
+    onp.testing.assert_allclose(out.asnumpy(), [-5.0])
+
+
+def test_cond_traced():
+    import jax
+
+    def f(p, a):
+        aw = nd._wrap(a)
+        out = nd.contrib.cond(nd._wrap(p),
+                              lambda t: t[0] * 2,
+                              lambda t: t[0] + 100,
+                              inputs=[aw])
+        return out._data
+
+    jf = jax.jit(f)
+    onp.testing.assert_allclose(
+        onp.asarray(jf(onp.bool_(True), onp.float32(3.0))), 6.0)
+    onp.testing.assert_allclose(
+        onp.asarray(jf(onp.bool_(False), onp.float32(3.0))), 103.0)
+
+
+def test_foreach_in_hybrid_block():
+    """foreach must be traceable inside a hybridized block."""
+    from mxnet_tpu import gluon
+
+    class Cum(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, _ = nd.contrib.foreach(
+                lambda xi, s: (xi + s, xi + s), x, nd.zeros_like(x[0]))
+            return outs
+
+    net = Cum()
+    net.hybridize()
+    x = nd.array(onp.arange(6, dtype=onp.float32).reshape(3, 2))
+    out = net(x)
+    expect = onp.cumsum(onp.arange(6).reshape(3, 2), axis=0)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
