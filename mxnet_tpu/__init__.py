@@ -44,6 +44,8 @@ from . import symbol as sym
 from . import module
 from . import visualization as viz
 from . import parallel
+from . import amp
+from . import contrib
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
            'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError']

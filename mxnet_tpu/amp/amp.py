@@ -1,0 +1,248 @@
+"""Automatic mixed precision (ref: python/mxnet/contrib/amp/amp.py:82-215).
+
+TPU-native: target dtype is bfloat16 (MXU-native; same exponent range as
+fp32, so loss scaling is optional rather than required as with fp16). The
+reference rewrites the op namespaces by wrapping each listed function with
+casts; we do the same to the `mxnet_tpu.ndarray` module — the `F` handle
+every Gluon layer dispatches through, eager and hybridized alike — so one
+patch point covers both execution modes. XLA fuses the inserted casts into
+the consuming matmul/conv, so autocast adds no extra HBM traffic.
+"""
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+import numpy as onp
+
+from ..base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+_amp_initialized = False
+_target_dtype = 'bfloat16'
+_originals = {}
+_patch_epoch = 0  # bumped on init/_deinit; part of the hybridize cache key
+
+
+def patch_epoch():
+    return _patch_epoch
+
+_LOW_DTYPES = ('float16', 'bfloat16')
+
+
+def _is_low_float(dt):
+    return str(dt) in _LOW_DTYPES
+
+
+def _is_float(dt):
+    s = str(dt)
+    if s in ('bfloat16', 'float16', 'float32', 'float64'):
+        return True
+    try:
+        return onp.issubdtype(onp.dtype(s), onp.floating)
+    except TypeError:
+        return False
+
+
+def _cast_nd(x, dtype):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, NDArray) and _is_float(x.dtype) and str(x.dtype) != dtype:
+        return x.astype(dtype)
+    return x
+
+
+def _map_args(args, kwargs, fn):
+    from ..ndarray.ndarray import NDArray
+    new_args = [fn(a) if isinstance(a, NDArray) else
+                ([fn(e) if isinstance(e, NDArray) else e for e in a]
+                 if isinstance(a, (list, tuple)) else a)
+                for a in args]
+    new_kwargs = {k: (fn(v) if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+    return new_args, new_kwargs
+
+
+def _wrap_lp16(orig, target):
+    def wrapper(*args, **kwargs):
+        a, k = _map_args(args, kwargs, lambda x: _cast_nd(x, target))
+        return orig(*a, **k)
+    wrapper.__name__ = getattr(orig, '__name__', 'amp_lp16')
+    wrapper.__amp_original__ = orig
+    return wrapper
+
+
+def _wrap_fp32(orig):
+    def wrapper(*args, **kwargs):
+        a, k = _map_args(args, kwargs,
+                         lambda x: _cast_nd(x, 'float32')
+                         if _is_low_float(x.dtype) else x)
+        return orig(*a, **k)
+    wrapper.__name__ = getattr(orig, '__name__', 'amp_fp32')
+    wrapper.__amp_original__ = orig
+    return wrapper
+
+
+def _wrap_widest(orig):
+    def wrapper(*args, **kwargs):
+        from ..ndarray.ndarray import NDArray
+        leaves = [a for a in args if isinstance(a, NDArray)]
+        for a in args:
+            if isinstance(a, (list, tuple)):
+                leaves += [e for e in a if isinstance(e, NDArray)]
+        float_dts = {str(x.dtype) for x in leaves if _is_float(x.dtype)}
+        if 'float32' in float_dts and (float_dts & set(_LOW_DTYPES)):
+            a, k = _map_args(args, kwargs,
+                             lambda x: _cast_nd(x, 'float32')
+                             if _is_low_float(x.dtype) else x)
+            return orig(*a, **k)
+        return orig(*args, **kwargs)
+    wrapper.__name__ = getattr(orig, '__name__', 'amp_widest')
+    wrapper.__amp_original__ = orig
+    return wrapper
+
+
+def init(target_dtype='bfloat16'):
+    """Turn on autocast (ref: amp.py:82 init). Patches the nd namespace in
+    place; ops in LP16_OPS run in `target_dtype`, FP32_OPS in fp32."""
+    global _amp_initialized, _target_dtype, _patch_epoch
+    if target_dtype not in _LOW_DTYPES:
+        raise MXNetError(f"AMP target_dtype must be one of {_LOW_DTYPES}, "
+                         f"got {target_dtype!r}")
+    if _amp_initialized:
+        return
+    logging.info("Using AMP (target_dtype=%s)", target_dtype)
+    _target_dtype = target_dtype
+    _patch_epoch += 1
+
+    from .. import ndarray as ndmod
+    for name in lists.LP16_OPS:
+        if hasattr(ndmod, name):
+            _originals[name] = getattr(ndmod, name)
+            setattr(ndmod, name, _wrap_lp16(_originals[name], target_dtype))
+    for name in lists.FP32_OPS:
+        if hasattr(ndmod, name):
+            _originals[name] = getattr(ndmod, name)
+            setattr(ndmod, name, _wrap_fp32(_originals[name]))
+    for name in lists.WIDEST_OPS:
+        if hasattr(ndmod, name):
+            _originals[name] = getattr(ndmod, name)
+            setattr(ndmod, name, _wrap_widest(_originals[name]))
+    _amp_initialized = True
+
+
+def _deinit():
+    """Undo init() — test helper; the reference has no un-init."""
+    global _amp_initialized, _patch_epoch
+    from .. import ndarray as ndmod
+    for name, orig in _originals.items():
+        setattr(ndmod, name, orig)
+    _originals.clear()
+    _amp_initialized = False
+    _patch_epoch += 1
+
+
+def init_trainer(optimizer_or_trainer, loss_scale=None):
+    """Attach a dynamic loss scaler to a Trainer (ref: amp.py init_trainer).
+
+    With bf16 the default scale is 1.0 (bf16 shares fp32's exponent range);
+    fp16 gets the reference's 2**16 dynamic scaler.
+    """
+    from ..gluon.trainer import Trainer
+    if not isinstance(optimizer_or_trainer, Trainer):
+        raise MXNetError("init_trainer expects a gluon.Trainer")
+    if loss_scale is None:
+        loss_scale = 1.0 if _target_dtype == 'bfloat16' else 2.**16
+    # bf16 shares fp32's exponent range: overflow checking is off unless the
+    # user opts into a real scale
+    scaler = LossScaler(init_scale=loss_scale,
+                        dynamic=(_target_dtype != 'bfloat16'
+                                 or loss_scale != 1.0))
+    optimizer_or_trainer._amp_loss_scaler = scaler
+    optimizer_or_trainer._amp_original_scale = optimizer_or_trainer._scale
+    return optimizer_or_trainer
+
+
+@contextmanager
+def scale_loss(loss, optimizer_or_trainer):
+    """Scale the loss and set the trainer to unscale gradients at step()
+    (ref: amp.py scale_loss)."""
+    scaler = getattr(optimizer_or_trainer, '_amp_loss_scaler', None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) before scale_loss")
+    optimizer_or_trainer._scale = (optimizer_or_trainer._amp_original_scale /
+                                   scaler.loss_scale)
+    if scaler.loss_scale == 1.0:
+        # bf16 default: no scaling needed, pass through unchanged (also
+        # keeps the graph intact if used outside autograd.record)
+        yield loss
+    elif isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(optimizer_or_trainer):
+    """Divide accumulated gradients by the loss scale in place."""
+    scaler = getattr(optimizer_or_trainer, '_amp_loss_scaler', None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) before unscale")
+    for p in optimizer_or_trainer._params:
+        if p.grad_req != 'null' and p._grad is not None:
+            for g in p.list_grad():
+                g[:] = g / scaler.loss_scale
+    # grads are now unscaled: step() must not divide by the scale again
+    optimizer_or_trainer._scale = optimizer_or_trainer._amp_original_scale
+
+
+_NORM_PARAM_SUFFIXES = ('gamma', 'beta', 'running_mean', 'running_var',
+                        'moving_mean', 'moving_var')
+
+
+def convert_hybrid_block(block, target_dtype='bfloat16',
+                         cast_optional_params=False):
+    """Offline conversion of a trained block for low-precision inference
+    (ref: amp.py convert_hybrid_block — which also returns a converted
+    copy, leaving the input model untouched). Casts weights to
+    `target_dtype` (norm-layer statistics stay fp32 unless
+    cast_optional_params) and returns a wrapper that casts inputs down and
+    outputs back to fp32 — the analog of the reference's inserted amp_cast
+    symbols.
+    """
+    import copy
+    from .. import gluon
+
+    block = copy.deepcopy(block)
+    for name, p in block.collect_params().items():
+        if not cast_optional_params and name.endswith(_NORM_PARAM_SUFFIXES):
+            continue
+        if p._data is not None and _is_float(p.dtype):
+            p.cast(target_dtype)
+
+    class _AMPConverted(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, *args):
+            cast = [_cast_nd(a, target_dtype) for a in args]
+            out = self.inner(*cast)
+            if isinstance(out, (list, tuple)):
+                return type(out)(_cast_nd(o, 'float32') for o in out)
+            return _cast_nd(out, 'float32')
+
+    return _AMPConverted(block)
+
+
+def convert_model(*args, **kwargs):
+    raise NotImplementedError(
+        "convert_model operates on the legacy symbol API; use "
+        "convert_hybrid_block (Module users: rebuild via gluon)")
+
+
+def list_lp16_ops():
+    return list(lists.LP16_OPS)
+
+
+def list_fp32_ops():
+    return list(lists.FP32_OPS)

@@ -1,0 +1,87 @@
+"""AMP op lists (ref: python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+TPU-native: the low-precision target is bfloat16 — the MXU's native input
+dtype — rather than fp16. Three classes, mirroring the reference's
+FP16_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS:
+
+- LP16_OPS: matmul-class ops where the FLOPs are; run in bf16 on the MXU.
+- FP32_OPS: numerically sensitive ops pinned to fp32.
+- WIDEST_OPS: multi-input elementwise ops cast to the widest input dtype.
+
+Ops not listed run in whatever dtype their inputs already have.
+"""
+
+# MXU-bound ops: cast float inputs down to the target dtype.
+LP16_OPS = [
+    'fully_connected',
+    'convolution',
+    'deconvolution',
+    'dot',
+    'batch_dot',
+    'rnn',
+    'interleaved_matmul_selfatt_qk',
+    'interleaved_matmul_selfatt_valatt',
+    'interleaved_matmul_encdec_qk',
+    'interleaved_matmul_encdec_valatt',
+]
+
+# Numerically sensitive: cast low-precision float inputs up to fp32.
+FP32_OPS = [
+    'softmax',
+    'log_softmax',
+    'softmax_cross_entropy',
+    'softmax_output',
+    'batch_norm',
+    'layer_norm',
+    'group_norm',
+    'instance_norm',
+    'l2_normalization',
+    'lrn',
+    'norm',
+    'exp',
+    'log',
+    'log2',
+    'log10',
+    'log1p',
+    'expm1',
+    'power',
+    'square',
+    'sqrt',
+    'rsqrt',
+    'cbrt',
+    'rcbrt',
+    'reciprocal',
+    'erfinv',
+    'gamma',
+    'gammaln',
+    'sum',
+    'mean',
+    'prod',
+    'nansum',
+    'nanprod',
+    'ctc_loss',
+    'smooth_l1',
+    'make_loss',
+]
+
+# Multi-input elementwise: unify on the widest floating dtype present.
+WIDEST_OPS = [
+    'broadcast_add',
+    'broadcast_sub',
+    'broadcast_mul',
+    'broadcast_div',
+    'broadcast_maximum',
+    'broadcast_minimum',
+    'broadcast_hypot',
+    'broadcast_power',
+    'elemwise_add',
+    'elemwise_sub',
+    'elemwise_mul',
+    'elemwise_div',
+    'add_n',
+    'concat',
+    'stack',
+    'where',
+    'maximum',
+    'minimum',
+]

@@ -1,0 +1,48 @@
+"""Dynamic loss scaler (ref: python/mxnet/contrib/amp/loss_scaler.py).
+
+With bf16 (the TPU default) the exponent range matches fp32 and scaling is
+a no-op; the scaler exists for fp16 parity and for users who opt into it.
+"""
+from __future__ import annotations
+
+
+class LossScaler:
+    """Doubles the scale every `scale_window` clean steps, halves on
+    non-finite gradients, and tells the trainer to skip that update."""
+
+    def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
+                 min_scale=1., dynamic=True):
+        self.loss_scale = float(init_scale)
+        self.dynamic = dynamic  # False for bf16: scaling is a formality
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._min_scale = float(min_scale)
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (ref: loss_scaler.py
+        has_overflow / multi_all_finite). Single device→host sync: the
+        per-grad finiteness bits are reduced on device first."""
+        import jax.numpy as jnp
+        bits = []
+        for p in params:
+            if p.grad_req == 'null' or p._grad is None:
+                continue
+            g = p._grad
+            grads = list(g) if (hasattr(g, '__iter__')
+                                and not hasattr(g, '_data')) else [g]
+            bits.extend(jnp.isfinite(garr._data).all() for garr in grads)
+        if not bits:
+            return False
+        return not bool(jnp.stack(bits).all())
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

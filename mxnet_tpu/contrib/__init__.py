@@ -1,0 +1,2 @@
+"""`mx.contrib` namespace (ref: python/mxnet/contrib/__init__.py)."""
+from .. import amp  # noqa: F401

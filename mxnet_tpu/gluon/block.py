@@ -302,6 +302,19 @@ class HybridBlock(Block):
             self._cached_op = CachedOp(self, self._flags)
         return self._cached_op(*args)
 
+    def __deepcopy__(self, memo):
+        """Copies drop the compiled trace cache (it closes over the original
+        block's parameter objects and jitted executables)."""
+        import copy as _copy
+        new = object.__new__(type(self))
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == '_cached_op':
+                new._cached_op = None
+            else:
+                setattr(new, k, _copy.deepcopy(v, memo))
+        return new
+
     def forward(self, x, *args):
         """Dispatch to hybrid_forward with params (ref: block.py:1156)."""
         ctx = x.context if isinstance(x, NDArray) else current_context()
@@ -365,9 +378,13 @@ class CachedOp:
             if p._data is None:
                 raise DeferredInitializationError(
                     f"Parameter '{p.name}' is deferred")
+        from ..amp import amp as _amp
         key = (tuple((x.shape, str(x.dtype)) if isinstance(x, NDArray) else None
                      for x in inputs),
                state.is_training,
+               # autocast state: a trace compiled before amp.init() must not
+               # be reused after it (and vice versa)
+               _amp.patch_epoch(),
                tuple(name for name, _ in params))
         entry = self._cache.get(key)
         if entry is None:

@@ -46,6 +46,19 @@ class Parameter:
         self._deferred_init = ()
         self._trace_tls = threading.local()
 
+    def __deepcopy__(self, memo):
+        """Deep-copy everything except the thread-local proxy stack (fresh
+        per copy) — required for amp.convert_hybrid_block's model clone."""
+        import copy as _copy
+        new = object.__new__(type(self))
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == '_trace_tls':
+                new._trace_tls = threading.local()
+            else:
+                setattr(new, k, _copy.deepcopy(v, memo))
+        return new
+
     # --- trace override: CachedOp substitutes tracer-backed proxies.
     # A stack, because hybridized blocks nest (a child CachedOp traces
     # inside its parent's trace and must restore the parent's proxies).

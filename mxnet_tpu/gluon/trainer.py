@@ -132,6 +132,16 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         """Ref: trainer.py:430."""
+        # AMP dynamic loss scaling: skip the update on non-finite grads and
+        # shrink the scale (ref: contrib/amp/loss_scaler.py via trainer
+        # hook). Lives here so both step() and update()/allreduce_grads()
+        # (gradient accumulation) are covered.
+        scaler = getattr(self, '_amp_loss_scaler', None)
+        if scaler is not None and scaler.dynamic:
+            overflow = scaler.has_overflow(self._params)
+            scaler.update_scale(overflow)
+            if overflow:
+                return
         if self._update_on_kvstore and self._kvstore is not None:
             for i, param in enumerate(self._params):
                 if param.grad_req == 'null' or param._data is None:

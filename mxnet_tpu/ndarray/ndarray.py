@@ -58,6 +58,18 @@ class NDArray:
         self._in_graph = False
         self._stype = 'default'
 
+    def __deepcopy__(self, memo):
+        import copy as _copy
+        new = object.__new__(type(self))
+        memo[id(self)] = new
+        for k in self.__slots__:
+            if k == '__weakref__':
+                continue
+            v = getattr(self, k)
+            # jax.Arrays are immutable: share the buffer instead of copying
+            setattr(new, k, v if k == '_data' else _copy.deepcopy(v, memo))
+        return new
+
     # ---- basic properties -------------------------------------------------
     @property
     def shape(self):
