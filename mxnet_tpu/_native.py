@@ -1,0 +1,104 @@
+"""Loader for the native IO runtime (src/io/mxtpu_io.cc).
+
+The analog of the reference's libmxnet.so ctypes bootstrap
+(ref: python/mxnet/base.py _load_lib) scoped to the IO runtime: the TPU
+compute path needs no native library (XLA is the backend), but the host
+data pipeline is C++ like the reference's (ref: src/io/). Falls back to
+pure Python transparently when the .so is absent and a build fails.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), '_lib', 'libmxtpu_io.so')
+_SRC_DIR = os.path.join(os.path.dirname(__file__), os.pardir, 'src')
+
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def _configure(lib):
+    u64 = ctypes.c_uint64
+    lib.mxt_recordio_writer_create.restype = ctypes.c_void_p
+    lib.mxt_recordio_writer_create.argtypes = [ctypes.c_char_p]
+    lib.mxt_recordio_writer_write.restype = ctypes.c_int
+    lib.mxt_recordio_writer_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(u64)]
+    lib.mxt_recordio_writer_free.argtypes = [ctypes.c_void_p]
+
+    lib.mxt_recordio_reader_create.restype = ctypes.c_void_p
+    lib.mxt_recordio_reader_create.argtypes = [ctypes.c_char_p]
+    lib.mxt_recordio_reader_read.restype = ctypes.c_int64
+    lib.mxt_recordio_reader_read.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.mxt_recordio_reader_tell.restype = u64
+    lib.mxt_recordio_reader_tell.argtypes = [ctypes.c_void_p]
+    lib.mxt_recordio_reader_seek.restype = ctypes.c_int
+    lib.mxt_recordio_reader_seek.argtypes = [ctypes.c_void_p, u64]
+    lib.mxt_recordio_reader_free.argtypes = [ctypes.c_void_p]
+
+    lib.mxt_pipeline_create.restype = ctypes.c_void_p
+    lib.mxt_pipeline_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, u64,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+    lib.mxt_pipeline_num_records.restype = ctypes.c_int64
+    lib.mxt_pipeline_num_records.argtypes = [ctypes.c_void_p]
+    lib.mxt_pipeline_next.restype = ctypes.c_int
+    lib.mxt_pipeline_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+    lib.mxt_pipeline_error.restype = ctypes.c_char_p
+    lib.mxt_pipeline_error.argtypes = [ctypes.c_void_p]
+    lib.mxt_pipeline_reset.argtypes = [ctypes.c_void_p]
+    lib.mxt_pipeline_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _try_build():
+    import logging
+    try:
+        subprocess.run(['make', '-C', os.path.abspath(_SRC_DIR)],
+                       check=True, capture_output=True, timeout=120)
+        logging.info("built native IO runtime at %s", _LIB_PATH)
+        return os.path.isfile(_LIB_PATH)
+    except subprocess.CalledProcessError as e:
+        logging.warning(
+            "native IO runtime build failed (falling back to pure Python); "
+            "run `make -C src` for details. stderr tail: %s",
+            e.stderr.decode(errors='replace')[-500:] if e.stderr else '')
+        return False
+    except Exception as e:
+        logging.warning("native IO runtime unavailable (%s); "
+                        "falling back to pure Python", e)
+        return False
+
+
+def get_lib():
+    """The native IO library, or None (pure-Python fallback)."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.isfile(_LIB_PATH):
+            if os.environ.get('MXNET_TPU_NO_NATIVE_BUILD'):
+                return None
+            if not _try_build():
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def native_available():
+    return get_lib() is not None

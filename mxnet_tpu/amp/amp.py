@@ -86,7 +86,8 @@ def _wrap_fp32(orig):
 def _wrap_widest(orig):
     def wrapper(*args, **kwargs):
         from ..ndarray.ndarray import NDArray
-        leaves = [a for a in args if isinstance(a, NDArray)]
+        leaves = [a for a in list(args) + list(kwargs.values())
+                  if isinstance(a, NDArray)]
         for a in args:
             if isinstance(a, (list, tuple)):
                 leaves += [e for e in a if isinstance(e, NDArray)]
@@ -110,6 +111,10 @@ def init(target_dtype='bfloat16'):
         raise MXNetError(f"AMP target_dtype must be one of {_LOW_DTYPES}, "
                          f"got {target_dtype!r}")
     if _amp_initialized:
+        if target_dtype != _target_dtype:
+            logging.warning(
+                "amp.init(target_dtype=%r) ignored: AMP already initialized "
+                "with target_dtype=%r", target_dtype, _target_dtype)
         return
     logging.info("Using AMP (target_dtype=%s)", target_dtype)
     _target_dtype = target_dtype

@@ -7,6 +7,7 @@ host→HBM copies overlap compute because jax dispatch is async.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import queue as _queue
 
@@ -329,11 +330,11 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size=1, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
-                 std_b=1.0, resize=-1, path_imgidx=None, **kwargs):
+                 std_b=1.0, resize=-1, path_imgidx=None,
+                 preprocess_threads=4, prefetch_buffer=4, seed=0, **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         self._rec_path = path_imgrec
-        self._record = recordio.MXRecordIO(path_imgrec, 'r')
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -342,6 +343,18 @@ class ImageRecordIter(DataIter):
         self.mean = onp.array([mean_r, mean_g, mean_b], onp.float32).reshape(3, 1, 1)
         self.std = onp.array([std_r, std_g, std_b], onp.float32).reshape(3, 1, 1)
         self.resize = resize
+        self._pipe = None
+        if self.data_shape[0] == 3:
+            self._pipe = _NativePipeline.try_create(
+                path_imgrec, batch_size, self.data_shape, label_width,
+                preprocess_threads, prefetch_buffer, resize, shuffle,
+                rand_crop, rand_mirror, seed,
+                (mean_r, mean_g, mean_b), (std_r, std_g, std_b))
+        if self._pipe is not None:
+            self._batch_data = None
+            return
+        # pure-Python fallback (non-JPEG data or no native lib)
+        self._record = recordio.MXRecordIO(path_imgrec, 'r')
         self._items = []
         self._load_all()
         self._order = onp.arange(len(self._items))
@@ -376,13 +389,29 @@ class ImageRecordIter(DataIter):
         return [DataDesc('softmax_label', shape)]
 
     def reset(self):
+        if self._pipe is not None:
+            self._pipe.reset()
+            self._batch_data = None
+            return
         if self.shuffle:
             onp.random.shuffle(self._order)
         self.cursor = -self.batch_size
 
     def iter_next(self):
+        if self._pipe is not None:
+            got = self._pipe.next()
+            if got is None:
+                self._batch_data = None
+                return False
+            data, label, count = got
+            self._pad = self.batch_size - count
+            self._batch_data = data
+            self._labels = (label[:, 0] if self.label_width == 1 else label)
+            return True
         self.cursor += self.batch_size
-        return self.cursor + self.batch_size <= len(self._items)
+        # the final partial batch is padded (matching the native pipeline)
+        # rather than dropped, so epoch size is identical on both paths
+        return self.cursor < len(self._items)
 
     def _augment(self, img):
         c, h, w = self.data_shape
@@ -410,18 +439,90 @@ class ImageRecordIter(DataIter):
         return (chw - self.mean) / self.std
 
     def getdata(self):
+        if self._pipe is not None:
+            return [array(self._batch_data)]
         batch = []
         labels = []
-        for i in range(self.cursor, self.cursor + self.batch_size):
+        end = min(self.cursor + self.batch_size, len(self._items))
+        for i in range(self.cursor, end):
             label, buf = self._items[self._order[i]]
             img = self._decode_image(buf)
             batch.append(self._augment(img))
             labels.append(label)
+        self._pad = self.batch_size - len(batch)
+        for _ in range(self._pad):
+            batch.append(onp.zeros_like(batch[0]))
+            labels.append(onp.zeros_like(onp.asarray(labels[0])))
         self._labels = onp.array(labels, onp.float32)
         return [array(onp.stack(batch))]
 
     def getlabel(self):
-        return [array(self._labels)]
+        return [array(onp.asarray(self._labels, onp.float32))]
 
     def getpad(self):
-        return 0
+        return getattr(self, '_pad', 0)
+
+
+class _NativePipeline:
+    """ctypes wrapper over the C++ threaded decode pipeline
+    (src/io/mxtpu_io.cc mxt_pipeline_*)."""
+
+    def __init__(self, lib, handle, batch_size, data_shape, label_width):
+        self._lib = lib
+        self._h = handle
+        self._batch_size = batch_size
+        self._shape = data_shape
+        self._label_width = label_width
+
+    @classmethod
+    def try_create(cls, path, batch_size, data_shape, label_width,
+                   threads, depth, resize, shuffle, rand_crop, rand_mirror,
+                   seed, mean, std):
+        import ctypes
+        from .. import _native
+        lib = _native.get_lib()
+        if lib is None or not os.path.isfile(path):
+            return None
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*mean)
+        std_arr = (ctypes.c_float * 3)(*std)
+        handle = lib.mxt_pipeline_create(
+            path.encode(), batch_size, h, w, label_width, threads, depth,
+            resize, int(bool(shuffle)), int(bool(rand_crop)),
+            int(bool(rand_mirror)), seed, mean_arr, std_arr)
+        if not handle:
+            return None
+        return cls(lib, handle, batch_size, data_shape, label_width)
+
+    def next(self):
+        """Returns (data NCHW f32, label (N,label_width) f32, count) or
+        None at epoch end."""
+        import ctypes
+        data_p = ctypes.POINTER(ctypes.c_float)()
+        label_p = ctypes.POINTER(ctypes.c_float)()
+        n = self._lib.mxt_pipeline_next(self._h, ctypes.byref(data_p),
+                                        ctypes.byref(label_p))
+        if n < 0:
+            raise MXNetError("native pipeline: " +
+                             self._lib.mxt_pipeline_error(self._h).decode())
+        if n == 0:
+            return None
+        c, h, w = self._shape
+        full = self._batch_size
+        data = onp.ctypeslib.as_array(
+            data_p, shape=(full, c, h, w)).copy()
+        label = onp.ctypeslib.as_array(
+            label_p, shape=(full, self._label_width)).copy()
+        return data, label, n
+
+    def num_records(self):
+        return self._lib.mxt_pipeline_num_records(self._h)
+
+    def reset(self):
+        self._lib.mxt_pipeline_reset(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.mxt_pipeline_free(self._h)
+        except Exception:
+            pass

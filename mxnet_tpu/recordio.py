@@ -31,30 +31,57 @@ def _decode_lrec(lrec):
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO)."""
+    """Sequential .rec reader/writer (ref: recordio.py MXRecordIO).
+
+    Backed by the native C++ runtime (src/io/mxtpu_io.cc — the analog of
+    dmlc-core's recordio + the reference's C API handles) when the shared
+    library is available; a pure-Python file path otherwise. Both produce
+    identical bytes.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._native = None
         self.is_open = False
         self.open()
 
     def open(self):
+        from . import _native
+        lib = _native.get_lib()
         if self.flag == 'w':
-            self.handle = open(self.uri, 'wb')
             self.writable = True
         elif self.flag == 'r':
-            self.handle = open(self.uri, 'rb')
             self.writable = False
         else:
             raise MXNetError(f"invalid flag {self.flag}")
+        if lib is not None:
+            path = self.uri.encode()
+            h = (lib.mxt_recordio_writer_create(path) if self.writable
+                 else lib.mxt_recordio_reader_create(path))
+            if not h:
+                raise MXNetError(f"cannot open {self.uri}")
+            self._native = (lib, h)
+            self._wpos = 0  # a reopen truncates; stale offsets corrupt .idx
+        else:
+            self.handle = open(self.uri, 'wb' if self.writable else 'rb')
         self.is_open = True
 
     def close(self):
-        if self.is_open and self.handle:
+        if not self.is_open:
+            return
+        if self._native is not None:
+            lib, h = self._native
+            if self.writable:
+                lib.mxt_recordio_writer_free(h)
+            else:
+                lib.mxt_recordio_reader_free(h)
+            self._native = None
+        if self.handle:
             self.handle.close()
-            self.is_open = False
+            self.handle = None
+        self.is_open = False
 
     def __del__(self):
         self.close()
@@ -62,6 +89,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d['handle'] = None
+        d['_native'] = None
         d['is_open'] = False
         return d
 
@@ -75,10 +103,33 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._native is not None:
+            lib, h = self._native
+            if self.writable:
+                return getattr(self, '_wpos', 0)
+            return lib.mxt_recordio_reader_tell(h)
         return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        if self._native is not None:
+            lib, h = self._native
+            lib.mxt_recordio_reader_seek(h, pos)
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf):
         assert self.writable
+        if self._native is not None:
+            import ctypes
+            lib, h = self._native
+            pos = ctypes.c_uint64()
+            if lib.mxt_recordio_writer_write(h, bytes(buf), len(buf),
+                                             ctypes.byref(pos)) != 0:
+                raise MXNetError(f"write failed on {self.uri}")
+            # next record's start offset, for MXIndexedRecordIO.write_idx
+            self._wpos = pos.value + 8 + len(buf) + (4 - len(buf) % 4) % 4
+            return
         lrec = _encode_lrec(0, len(buf))
         self.handle.write(struct.pack('<II', _MAGIC, lrec))
         self.handle.write(buf)
@@ -88,6 +139,16 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._native is not None:
+            import ctypes
+            lib, h = self._native
+            out = ctypes.c_char_p()
+            n = lib.mxt_recordio_reader_read(h, ctypes.byref(out))
+            if n == -1:
+                return None
+            if n < 0:
+                raise MXNetError(f"invalid record magic in {self.uri}")
+            return ctypes.string_at(out, n)
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -134,7 +195,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self.handle.seek(self.idx[idx])
+        super().seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,562 @@
+// mxtpu_io: native IO runtime for the TPU-native framework.
+//
+// TPU-native equivalent of the reference's C++ data pipeline
+// (ref: src/io/iter_image_recordio_2.cc:880, src/io/iter_prefetcher.h,
+// dmlc-core recordio). The reference builds a chain of
+// recordio-chunk-reader -> threaded JPEG decode/augment -> batcher ->
+// prefetcher; this file implements the same stages with a reorder-buffer
+// worker pool feeding pre-allocated host batch buffers, exposed through a
+// flat C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Framing is binary-compatible with dmlc recordio:
+//   [magic u32 = 0xced7230a][lrec u32: cflag<<29 | len][payload][pad to 4B]
+// Image records carry an IRHeader {flag u32, label f32, id u64, id2 u64}
+// followed by `flag` extra f32 labels, then JPEG bytes.
+
+#include <atomic>
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+#include <jpeglib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+static_assert(sizeof(IRHeader) == 24, "IRHeader layout");
+
+// ---------------------------------------------------------------------------
+// RecordIO writer / reader
+// ---------------------------------------------------------------------------
+
+struct RecordIOWriter {
+  FILE* fp = nullptr;
+  uint64_t nrecords = 0;
+};
+
+struct RecordIOReader {
+  FILE* fp = nullptr;
+  std::vector<char> buf;
+};
+
+bool write_record(FILE* fp, const char* data, uint32_t len) {
+  uint32_t head[2] = {kMagic, len & ((1u << 29) - 1)};
+  if (fwrite(head, 4, 2, fp) != 2) return false;
+  if (len && fwrite(data, 1, len, fp) != len) return false;
+  uint32_t pad = (4 - len % 4) % 4;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, fp) != pad) return false;
+  return true;
+}
+
+// Reads one framed record into out. Returns 0 on success, -1 on clean
+// EOF, -2 on corruption (bad magic / truncated payload) — callers must
+// not conflate truncation with end-of-data.
+int read_record(FILE* fp, std::vector<char>* out) {
+  uint32_t head[2];
+  size_t got = fread(head, 4, 2, fp);
+  if (got == 0 && feof(fp)) return -1;
+  if (got != 2) return -2;
+  if (head[0] != kMagic) return -2;
+  uint32_t len = head[1] & ((1u << 29) - 1);
+  out->resize(len);
+  if (len && fread(out->data(), 1, len, fp) != len) return -2;
+  uint32_t pad = (4 - len % 4) % 4;
+  if (pad) fseek(fp, pad, SEEK_CUR);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg) + bilinear resize
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+// Decodes JPEG to RGB u8 HWC. Returns false on failure.
+bool decode_jpeg(const uint8_t* src, size_t len,
+                 std::vector<uint8_t>* out, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, src, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(size_t(*w) * (*h) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + size_t(cinfo.output_scanline) * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear RGB u8 HWC resize.
+void resize_bilinear(const uint8_t* src, int sh, int sw,
+                     uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = ry * y;
+    int y0 = int(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = rx * x;
+      int x0 = int(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(size_t(y0) * sw + x0) * 3 + c];
+        float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
+        float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
+        float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
+        float top = v00 + (v01 - v00) * wx;
+        float bot = v10 + (v11 - v10) * wx;
+        dst[(size_t(y) * dw + x) * 3 + c] =
+            uint8_t(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// ImageRecordPipeline: offsets scan -> worker pool decode -> reorder queue
+// ---------------------------------------------------------------------------
+
+struct PipelineConfig {
+  int batch_size;
+  int height, width;       // output HW (channels fixed at 3)
+  int label_width;
+  int num_threads;
+  int prefetch_depth;      // max in-flight decoded batches
+  int resize_short;        // resize shorter side to this before crop (<=0 off)
+  int shuffle;
+  int rand_crop;
+  int rand_mirror;
+  uint64_t seed;
+  float mean[3];
+  float std[3];
+};
+
+struct Batch {
+  std::vector<float> data;    // batch*3*H*W, CHW per image
+  std::vector<float> label;   // batch*label_width
+  int count = 0;
+};
+
+struct Pipeline {
+  PipelineConfig cfg;
+  std::string path;
+  std::vector<std::pair<uint64_t, uint32_t>> offsets;  // (pos, payload len)
+  std::vector<uint32_t> order;
+  uint64_t epoch = 0;
+
+  std::vector<std::thread> workers;
+  std::atomic<int> next_batch_to_claim{0};
+  int num_batches = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::map<int, Batch> ready;   // reorder buffer keyed by batch index
+  int next_batch_out = 0;
+  bool stopping = false;
+  std::string error;            // first worker error, reported at next()
+
+  Batch current;                // last batch handed to the caller
+};
+
+// Scans the .rec file once, recording payload offsets (the analog of the
+// reference's .idx file, built on the fly so one works without an index).
+// A file that does not terminate at a clean record boundary is rejected
+// (create fails, Python falls back to its raising reader) rather than
+// silently truncated.
+bool scan_offsets(Pipeline* p) {
+  FILE* fp = fopen(p->path.c_str(), "rb");
+  if (!fp) return false;
+  fseek(fp, 0, SEEK_END);
+  const uint64_t fsize = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  uint32_t head[2];
+  bool clean_end = false;
+  for (;;) {
+    uint64_t pos = ftell(fp);
+    size_t got = fread(head, 4, 2, fp);
+    if (got == 0 && feof(fp)) {
+      clean_end = true;
+      break;
+    }
+    if (got != 2 || head[0] != kMagic) break;
+    uint32_t len = head[1] & ((1u << 29) - 1);
+    uint32_t skip = len + (4 - len % 4) % 4;
+    if (pos + 8 + skip > fsize) break;  // payload truncated (fseek past
+                                        // EOF would not detect this)
+    if (fseek(fp, skip, SEEK_CUR) != 0) break;
+    p->offsets.emplace_back(pos + 8, len);
+  }
+  fclose(fp);
+  return clean_end && !p->offsets.empty();
+}
+
+void set_error(Pipeline* p, const std::string& msg) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (p->error.empty()) p->error = msg;
+  p->cv_ready.notify_all();
+}
+
+// Decodes one record into slot i of the batch. Mean/std are applied here so
+// the output is ready for device transfer with no further host math.
+bool process_record(Pipeline* p, const std::vector<char>& rec, Batch* b,
+                    int i, std::mt19937* rng) {
+  const PipelineConfig& c = p->cfg;
+  if (rec.size() < sizeof(IRHeader)) return false;
+  IRHeader hdr;
+  memcpy(&hdr, rec.data(), sizeof(hdr));
+  const uint8_t* payload =
+      reinterpret_cast<const uint8_t*>(rec.data()) + sizeof(hdr);
+  size_t payload_len = rec.size() - sizeof(hdr);
+
+  float* lbl = b->label.data() + size_t(i) * c.label_width;
+  if (hdr.flag > 0) {
+    size_t nl = std::min<size_t>(hdr.flag, c.label_width);
+    if (payload_len < hdr.flag * 4) return false;
+    memcpy(lbl, payload, nl * 4);
+    for (size_t k = nl; k < size_t(c.label_width); ++k) lbl[k] = 0.f;
+    payload += hdr.flag * 4;
+    payload_len -= hdr.flag * 4;
+  } else {
+    lbl[0] = hdr.label;
+    for (int k = 1; k < c.label_width; ++k) lbl[k] = 0.f;
+  }
+
+  std::vector<uint8_t> img;
+  int h = 0, w = 0;
+  if (!decode_jpeg(payload, payload_len, &img, &h, &w)) return false;
+
+  if (c.resize_short > 0) {
+    int shorter = std::min(h, w);
+    if (shorter != c.resize_short) {
+      int nh = int(int64_t(h) * c.resize_short / shorter);
+      int nw = int(int64_t(w) * c.resize_short / shorter);
+      std::vector<uint8_t> resized(size_t(nh) * nw * 3);
+      resize_bilinear(img.data(), h, w, resized.data(), nh, nw);
+      img.swap(resized);
+      h = nh; w = nw;
+    }
+  }
+
+  // crop to target (random or center), resizing up if the source is smaller
+  int th = c.height, tw = c.width;
+  std::vector<uint8_t> crop(size_t(th) * tw * 3);
+  if (h >= th && w >= tw) {
+    int y0, x0;
+    if (c.rand_crop) {
+      y0 = int((*rng)() % (h - th + 1));
+      x0 = int((*rng)() % (w - tw + 1));
+    } else {
+      y0 = (h - th) / 2;
+      x0 = (w - tw) / 2;
+    }
+    for (int y = 0; y < th; ++y)
+      memcpy(crop.data() + size_t(y) * tw * 3,
+             img.data() + (size_t(y0 + y) * w + x0) * 3, size_t(tw) * 3);
+  } else {
+    resize_bilinear(img.data(), h, w, crop.data(), th, tw);
+  }
+
+  bool mirror = c.rand_mirror && ((*rng)() & 1);
+
+  // HWC u8 -> CHW f32 normalized
+  float* out = b->data.data() + size_t(i) * 3 * th * tw;
+  for (int ch = 0; ch < 3; ++ch) {
+    float m = c.mean[ch], s = c.std[ch];
+    float inv = s != 0.f ? 1.f / s : 1.f;
+    float* plane = out + size_t(ch) * th * tw;
+    for (int y = 0; y < th; ++y) {
+      for (int x = 0; x < tw; ++x) {
+        int sx = mirror ? (tw - 1 - x) : x;
+        plane[size_t(y) * tw + x] =
+            (float(crop[(size_t(y) * tw + sx) * 3 + ch]) - m) * inv;
+      }
+    }
+  }
+  b->count = std::max(b->count, i + 1);
+  return true;
+}
+
+void worker_loop(Pipeline* p, int worker_id) {
+  FILE* fp = fopen(p->path.c_str(), "rb");
+  if (!fp) {
+    set_error(p, "worker failed to open " + p->path);
+    return;
+  }
+  const PipelineConfig& c = p->cfg;
+  std::mt19937 rng(uint32_t(c.seed + p->epoch * 1315423911u + worker_id));
+  std::vector<char> rec;
+
+  for (;;) {
+    int bidx = p->next_batch_to_claim.fetch_add(1);
+    if (bidx >= p->num_batches) break;
+    {
+      // bounded prefetch: don't run ahead of the consumer by > depth
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->cv_space.wait(lk, [&] {
+        return p->stopping || bidx < p->next_batch_out + c.prefetch_depth;
+      });
+      if (p->stopping) break;
+    }
+    Batch b;
+    b.data.resize(size_t(c.batch_size) * 3 * c.height * c.width);
+    b.label.assign(size_t(c.batch_size) * c.label_width, 0.f);
+    int start = bidx * c.batch_size;
+    int end = std::min<int>(start + c.batch_size, int(p->order.size()));
+    int slot = 0;
+    for (int k = start; k < end; ++k) {
+      auto [pos, len] = p->offsets[p->order[k]];
+      rec.resize(len);
+      if (fseek(fp, long(pos), SEEK_SET) != 0 ||
+          fread(rec.data(), 1, len, fp) != len) {
+        set_error(p, "short read in " + p->path);
+        fclose(fp);
+        return;
+      }
+      if (process_record(p, rec, &b, slot, &rng)) {
+        ++slot;   // undecodable records are skipped, batch shrinks
+      }
+    }
+    b.count = slot;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->ready.emplace(bidx, std::move(b));
+      p->cv_ready.notify_all();
+    }
+  }
+  fclose(fp);
+}
+
+void stop_workers(Pipeline* p) {
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->cv_space.notify_all();
+  p->cv_ready.notify_all();
+  for (auto& t : p->workers) t.join();
+  p->workers.clear();
+  p->stopping = false;
+}
+
+void start_epoch(Pipeline* p) {
+  stop_workers(p);
+  p->ready.clear();
+  p->next_batch_out = 0;
+  p->next_batch_to_claim = 0;
+  p->num_batches =
+      int((p->order.size() + p->cfg.batch_size - 1) / p->cfg.batch_size);
+  if (p->cfg.shuffle) {
+    std::mt19937_64 rng(p->cfg.seed + p->epoch);
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  int n = std::max(1, p->cfg.num_threads);
+  for (int i = 0; i < n; ++i)
+    p->workers.emplace_back(worker_loop, p, i);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* mxt_recordio_writer_create(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  auto* w = new RecordIOWriter();
+  w->fp = fp;
+  return w;
+}
+
+int mxt_recordio_writer_write(void* handle, const char* buf, uint32_t len,
+                              uint64_t* out_pos) {
+  auto* w = static_cast<RecordIOWriter*>(handle);
+  if (out_pos) *out_pos = ftell(w->fp);
+  if (!write_record(w->fp, buf, len)) return -1;
+  ++w->nrecords;
+  return 0;
+}
+
+void mxt_recordio_writer_free(void* handle) {
+  auto* w = static_cast<RecordIOWriter*>(handle);
+  if (w->fp) fclose(w->fp);
+  delete w;
+}
+
+void* mxt_recordio_reader_create(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* r = new RecordIOReader();
+  r->fp = fp;
+  return r;
+}
+
+// Returns payload length (>=0) with *out pointing at an internal buffer
+// valid until the next call, -1 at clean EOF, -2 on a corrupt record.
+int64_t mxt_recordio_reader_read(void* handle, const char** out) {
+  auto* r = static_cast<RecordIOReader*>(handle);
+  int rc = read_record(r->fp, &r->buf);
+  if (rc != 0) return rc;
+  *out = r->buf.data();
+  return int64_t(r->buf.size());
+}
+
+uint64_t mxt_recordio_reader_tell(void* handle) {
+  return ftell(static_cast<RecordIOReader*>(handle)->fp);
+}
+
+int mxt_recordio_reader_seek(void* handle, uint64_t pos) {
+  return fseek(static_cast<RecordIOReader*>(handle)->fp, long(pos), SEEK_SET);
+}
+
+void mxt_recordio_reader_free(void* handle) {
+  auto* r = static_cast<RecordIOReader*>(handle);
+  if (r->fp) fclose(r->fp);
+  delete r;
+}
+
+// --- image pipeline --------------------------------------------------------
+
+void* mxt_pipeline_create(const char* rec_path, int batch_size, int height,
+                          int width, int label_width, int num_threads,
+                          int prefetch_depth, int resize_short, int shuffle,
+                          int rand_crop, int rand_mirror, uint64_t seed,
+                          const float* mean, const float* stdv) {
+  auto* p = new Pipeline();
+  p->path = rec_path;
+  p->cfg = PipelineConfig{batch_size, height, width, label_width,
+                          num_threads, std::max(1, prefetch_depth),
+                          resize_short, shuffle, rand_crop, rand_mirror,
+                          seed, {mean[0], mean[1], mean[2]},
+                          {stdv[0], stdv[1], stdv[2]}};
+  if (!scan_offsets(p)) {
+    delete p;
+    return nullptr;
+  }
+  // probe: the first record must JPEG-decode, otherwise this dataset is
+  // not ours to serve (e.g. PNG payloads) — fail so the caller can fall
+  // back to a decoder that handles it, instead of yielding empty epochs
+  {
+    FILE* fp = fopen(p->path.c_str(), "rb");
+    std::vector<char> rec(p->offsets[0].second);
+    bool ok = fp != nullptr &&
+              fseek(fp, long(p->offsets[0].first), SEEK_SET) == 0 &&
+              fread(rec.data(), 1, rec.size(), fp) == rec.size();
+    if (fp) fclose(fp);
+    if (ok && rec.size() > sizeof(IRHeader)) {
+      IRHeader hdr;
+      memcpy(&hdr, rec.data(), sizeof(hdr));
+      size_t off = sizeof(hdr) + size_t(hdr.flag) * 4;
+      std::vector<uint8_t> img;
+      int h = 0, w = 0;
+      ok = off < rec.size() &&
+           decode_jpeg(reinterpret_cast<const uint8_t*>(rec.data()) + off,
+                       rec.size() - off, &img, &h, &w);
+    }
+    if (!ok) {
+      delete p;
+      return nullptr;
+    }
+  }
+  p->order.resize(p->offsets.size());
+  for (uint32_t i = 0; i < p->order.size(); ++i) p->order[i] = i;
+  start_epoch(p);
+  return p;
+}
+
+int64_t mxt_pipeline_num_records(void* handle) {
+  return int64_t(static_cast<Pipeline*>(handle)->offsets.size());
+}
+
+// Blocks for the next decoded batch. Returns count (0 = epoch end, -1 =
+// error; message via mxt_pipeline_error). Pointers valid until the next
+// next()/reset()/free().
+int mxt_pipeline_next(void* handle, const float** data, const float** label) {
+  auto* p = static_cast<Pipeline*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  // a batch whose records all failed decode is skipped, not surfaced as
+  // count==0 (which means epoch end to the caller)
+  for (;;) {
+    if (p->next_batch_out >= p->num_batches) return 0;
+    p->cv_ready.wait(lk, [&] {
+      return !p->error.empty() || p->ready.count(p->next_batch_out) > 0;
+    });
+    if (!p->error.empty()) return -1;
+    auto it = p->ready.find(p->next_batch_out);
+    p->current = std::move(it->second);
+    p->ready.erase(it);
+    ++p->next_batch_out;
+    p->cv_space.notify_all();
+    if (p->current.count > 0) break;
+  }
+  *data = p->current.data.data();
+  *label = p->current.label.data();
+  return p->current.count;
+}
+
+const char* mxt_pipeline_error(void* handle) {
+  return static_cast<Pipeline*>(handle)->error.c_str();
+}
+
+// Rewinds to a fresh epoch (reshuffling if configured).
+void mxt_pipeline_reset(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  ++p->epoch;
+  start_epoch(p);
+}
+
+void mxt_pipeline_free(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  stop_workers(p);
+  delete p;
+}
+
+}  // extern "C"

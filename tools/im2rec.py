@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / .lst file into RecordIO (+index).
+
+Ref: tools/im2rec.py in the reference (same CLI shape: make-list then
+pack). Produces .rec files readable by mxnet_tpu.io.ImageRecordIter's
+native C++ pipeline and by the reference framework alike.
+
+Usage:
+  python tools/im2rec.py --make-list PREFIX IMAGE_DIR
+  python tools/im2rec.py PREFIX IMAGE_DIR [--resize N] [--quality Q]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+EXTS = ('.jpg', '.jpeg', '.png')
+
+
+def list_images(root):
+    items = []
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if classes:
+        for label, cls in enumerate(classes):
+            for fn in sorted(os.listdir(os.path.join(root, cls))):
+                if fn.lower().endswith(EXTS):
+                    items.append((os.path.join(cls, fn), float(label)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                items.append((fn, 0.0))
+    return items
+
+
+def write_list(prefix, items):
+    with open(prefix + '.lst', 'w') as f:
+        for i, (path, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{path}\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split('\t')
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack_rec(prefix, root, resize=0, quality=95, shuffle=False):
+    from mxnet_tpu import recordio
+    from PIL import Image
+    import io as pyio
+
+    lst = list(read_list(prefix + '.lst'))
+    if shuffle:
+        random.shuffle(lst)
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    for idx, labels, rel in lst:
+        img = Image.open(os.path.join(root, rel)).convert('RGB')
+        if resize:
+            w, h = img.size
+            scale = resize / min(w, h)
+            img = img.resize((max(resize, int(w * scale)),
+                              max(resize, int(h * scale))))
+        buf = pyio.BytesIO()
+        img.save(buf, format='JPEG', quality=quality)
+        if len(labels) == 1:
+            header = recordio.IRHeader(0, labels[0], idx, 0)
+        else:
+            header = recordio.IRHeader(len(labels), labels, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+    rec.close()
+    print(f"packed {len(lst)} images into {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('prefix')
+    ap.add_argument('root')
+    ap.add_argument('--make-list', action='store_true')
+    ap.add_argument('--resize', type=int, default=0)
+    ap.add_argument('--quality', type=int, default=95)
+    ap.add_argument('--shuffle', action='store_true')
+    args = ap.parse_args()
+
+    if args.make_list:
+        items = list_images(args.root)
+        write_list(args.prefix, items)
+        print(f"wrote {len(items)} entries to {args.prefix}.lst")
+    else:
+        if not os.path.isfile(args.prefix + '.lst'):
+            write_list(args.prefix, list_images(args.root))
+        pack_rec(args.prefix, args.root, args.resize, args.quality,
+                 args.shuffle)
+
+
+if __name__ == '__main__':
+    main()
