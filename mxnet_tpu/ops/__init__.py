@@ -18,6 +18,7 @@ from . import sequence    # noqa: F401
 from . import attention   # noqa: F401
 from . import contrib     # noqa: F401
 from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401
 
 from .elemwise import *     # noqa: F401,F403
 from .reduce import *       # noqa: F401,F403
@@ -30,3 +31,4 @@ from .optimizer_ops import *  # noqa: F401,F403
 from .sequence import *     # noqa: F401,F403
 from .attention import *    # noqa: F401,F403
 from .contrib import *      # noqa: F401,F403
+from .quantization import *  # noqa: F401,F403

@@ -42,6 +42,11 @@ def _tup(v, n):
     return v
 
 
+# conv dimension_numbers by spatial rank, shared with quantized_conv
+_CONV_DN = {1: ('NCH', 'OIH', 'NCH'), 2: ('NCHW', 'OIHW', 'NCHW'),
+            3: ('NCDHW', 'OIDHW', 'NCDHW')}
+
+
 @_reg
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
                     flatten=True):
@@ -67,8 +72,7 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = _tup(stride, nd) if stride is not None else (1,) * nd
     dilate = _tup(dilate, nd) if dilate is not None else (1,) * nd
     pad = _tup(pad, nd)
-    dn = {1: ('NCH', 'OIH', 'NCH'), 2: ('NCHW', 'OIHW', 'NCHW'),
-          3: ('NCDHW', 'OIDHW', 'NCDHW')}[nd]
+    dn = _CONV_DN[nd]
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],

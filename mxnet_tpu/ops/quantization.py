@@ -1,0 +1,237 @@
+"""INT8 quantization ops (ref: src/operator/quantization/).
+
+TPU-first design: the reference implements quantized kernels with MKLDNN /
+cuDNN (quantized_conv.cc, quantized_fully_connected.cc, quantize_v2.cc,
+dequantize.cc, requantize.cc).  On TPU the MXU multiplies int8 operands
+natively with int32 accumulation, which XLA reaches through
+``lax.dot_general(..., preferred_element_type=int32)`` on int8 inputs — so
+quantized compute here is ordinary traced ops, fused and scheduled by XLA,
+not hand-written kernels.
+
+Quantization scheme (matches reference semantics):
+  * int8: symmetric.  scale = 127 / max(|min|, |max|);  q = round(x * scale)
+  * uint8: affine.    scale = 255 / (max - min);        q = round((x-min)*scale)
+  * int8 x int8 matmul/conv accumulates to int32; the float range of the
+    int32 output follows the reference's quantization_range_for_multiplication
+    (quantization_utils.h): out_range = int32_range / (scale_data*scale_weight).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+from .nn import _tup, _CONV_DN
+
+__all__ = []
+
+INT8_RANGE = 127.0
+UINT8_RANGE = 255.0
+INT32_RANGE = float(2 ** 31 - 1)
+
+
+def _reg(fn, num_outputs=1):
+    register_op(fn.__name__, num_outputs=num_outputs, nograd=True)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _regn(n):
+    return lambda fn: _reg(fn, num_outputs=n)
+
+
+def _scalar(x):
+    """Accept python floats or 1-element arrays for range arguments."""
+    if hasattr(x, 'shape'):
+        return jnp.reshape(x, ()).astype(jnp.float32)
+    return jnp.float32(x)
+
+
+def int8_scale(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(_scalar(min_range)), jnp.abs(_scalar(max_range)))
+    return INT8_RANGE / jnp.maximum(amax, 1e-30)
+
+
+@_regn(3)
+def quantize(data, min_range, max_range, out_type='uint8'):
+    """Affine/symmetric quantize with explicit range (ref: quantize.cc)."""
+    lo, hi = _scalar(min_range), _scalar(max_range)
+    if out_type == 'uint8':
+        scale = UINT8_RANGE / jnp.maximum(hi - lo, 1e-30)
+        q = jnp.clip(jnp.round((data.astype(jnp.float32) - lo) * scale),
+                     0, 255).astype(jnp.uint8)
+        return q, lo, hi
+    scale = int8_scale(lo, hi)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                 -127, 127).astype(jnp.int8)
+    amax = INT8_RANGE / scale
+    return q, -amax, amax
+
+
+@_regn(3)
+def quantize_v2(data, out_type='int8', min_calib_range=None,
+                max_calib_range=None):
+    """Quantize with calibrated or on-the-fly range (ref: quantize_v2.cc)."""
+    if out_type == 'auto':
+        out_type = 'int8'
+    if min_calib_range is None or max_calib_range is None:
+        lo = jnp.min(data).astype(jnp.float32)
+        hi = jnp.max(data).astype(jnp.float32)
+    else:
+        lo, hi = _scalar(min_calib_range), _scalar(max_calib_range)
+    return quantize(data, lo, hi, out_type=out_type)
+
+
+@_reg
+def dequantize(data, min_range, max_range, out_type='float32'):
+    """Ref: dequantize.cc."""
+    lo, hi = _scalar(min_range), _scalar(max_range)
+    if data.dtype == jnp.uint8:
+        scale = UINT8_RANGE / jnp.maximum(hi - lo, 1e-30)
+        return (data.astype(jnp.float32) / scale + lo).astype(out_type)
+    if data.dtype == jnp.int32:
+        scale = INT32_RANGE / jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        return (data.astype(jnp.float32) / scale).astype(out_type)
+    scale = int8_scale(lo, hi)
+    return (data.astype(jnp.float32) / scale).astype(out_type)
+
+
+@_regn(3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8 rescale (ref: requantize.cc)."""
+    f = dequantize(data, min_range, max_range)
+    if min_calib_range is not None and max_calib_range is not None:
+        lo, hi = _scalar(min_calib_range), _scalar(max_calib_range)
+    else:
+        lo = jnp.min(f)
+        hi = jnp.max(f)
+    return quantize(f, lo, hi, out_type='int8')
+
+
+def _mul_out_range(min_d, max_d, min_w, max_w):
+    """Float range represented by the int32 accumulator
+    (ref: quantization_utils.h quantization_range_for_multiplication)."""
+    sd = int8_scale(min_d, max_d)
+    sw = int8_scale(min_w, max_w)
+    amax = INT32_RANGE / (sd * sw)
+    return -amax, amax, sd, sw
+
+
+@_regn(3)
+def quantized_fully_connected(data, weight, bias=None, min_data=None,
+                              max_data=None, min_weight=None, max_weight=None,
+                              min_bias=None, max_bias=None, num_hidden=None,
+                              no_bias=False, flatten=True):
+    """int8 x int8 -> int32 FC on the MXU (ref: quantized_fully_connected.cc).
+
+    ``data``/``weight`` are int8; bias (if given) is int8 with its own range
+    and is rescaled into the int32 accumulator's scale.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(data, weight,
+                          (((data.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    lo, hi, sd, sw = _mul_out_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        sb = int8_scale(min_bias, max_bias)
+        bias32 = jnp.round(bias.astype(jnp.float32) / sb * (sd * sw))
+        out = out + bias32.astype(jnp.int32)
+    return out, lo, hi
+
+
+@_regn(3)
+def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None, min_bias=None,
+                   max_bias=None, kernel=None, stride=None, dilate=None,
+                   pad=None, num_filter=0, num_group=1, no_bias=False,
+                   layout='NCHW'):
+    """int8 conv with int32 accumulation (ref: quantized_conv.cc)."""
+    nd = data.ndim - 2
+    stride = _tup(stride, nd) if stride is not None else (1,) * nd
+    dilate = _tup(dilate, nd) if dilate is not None else (1,) * nd
+    pad = _tup(pad, nd)
+    dn = _CONV_DN[nd]
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    lo, hi, sd, sw = _mul_out_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        sb = int8_scale(min_bias, max_bias)
+        bias32 = jnp.round(bias.astype(jnp.float32) / sb * (sd * sw))
+        out = out + bias32.astype(jnp.int32).reshape((1, -1) + (1,) * nd)
+    return out, lo, hi
+
+
+@_regn(3)
+def quantized_pooling(data, min_data, max_data, kernel=None, stride=None,
+                      pad=None, pool_type='max', global_pool=False):
+    """Pooling runs directly on the int8 domain (ref: quantized_pooling.cc);
+    max-pool is exact, avg-pool accumulates in int32 then rounds back."""
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd) if stride is not None else (1,) * nd
+    pad = _tup(pad, nd)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    info = jnp.iinfo(data.dtype)
+    if pool_type == 'max':
+        out = lax.reduce_window(data, jnp.array(info.min, data.dtype),
+                                lax.max, dims, strides, padding)
+    else:
+        s = lax.reduce_window(data.astype(jnp.int32), jnp.int32(0), lax.add,
+                              dims, strides, padding)
+        n = 1
+        for k in kernel:
+            n *= k
+        out = jnp.clip(jnp.round(s / n), info.min, info.max).astype(data.dtype)
+    return out, _scalar(min_data), _scalar(max_data)
+
+
+@_regn(3)
+def quantized_flatten(data, min_data, max_data):
+    """Ref: quantized_flatten.cc."""
+    return (data.reshape(data.shape[0], -1), _scalar(min_data),
+            _scalar(max_data))
+
+
+@_regn(3)
+def quantized_concat(*args, dim=1):
+    """Concat int8 inputs after rescaling to a shared range
+    (ref: quantized_concat.cc). Args: d0, min0, max0, d1, min1, max1, ..."""
+    n = len(args) // 3
+    datas = args[0::3][:n]
+    mins = [_scalar(a) for a in args[1::3][:n]]
+    maxs = [_scalar(a) for a in args[2::3][:n]]
+    amax = jnp.stack([jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+                      for lo, hi in zip(mins, maxs)]).max()
+    parts = []
+    for d, lo, hi in zip(datas, mins, maxs):
+        s_in = int8_scale(lo, hi)
+        s_out = INT8_RANGE / amax
+        parts.append(jnp.clip(jnp.round(d.astype(jnp.float32) / s_in * s_out),
+                              -127, 127).astype(jnp.int8))
+    return jnp.concatenate(parts, axis=dim), -amax, amax
+
+
+@_regn(3)
+def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    """Ref: quantized_elemwise_add.cc — add in the dequantized domain,
+    re-quantize to the combined range (XLA fuses this into one kernel)."""
+    fl = dequantize(lhs, min_lhs, max_lhs)
+    fr = dequantize(rhs, min_rhs, max_rhs)
+    out = fl + fr
+    amax = (jnp.maximum(jnp.abs(_scalar(min_lhs)), jnp.abs(_scalar(max_lhs)))
+            + jnp.maximum(jnp.abs(_scalar(min_rhs)),
+                          jnp.abs(_scalar(max_rhs))))
+    s = INT8_RANGE / jnp.maximum(amax, 1e-30)
+    q = jnp.clip(jnp.round(out * s), -127, 127).astype(jnp.int8)
+    return q, -amax, amax

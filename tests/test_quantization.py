@@ -1,0 +1,221 @@
+"""INT8 quantization tests (ref: tests/python/quantization/test_quantization.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib.quantization import (
+    quantize_net, QuantizedDense, QuantizedConv2D, _get_optimal_threshold)
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = nd.array(onp.random.RandomState(0).uniform(-3, 3, (4, 16)).astype('float32'))
+    q, lo, hi = nd.quantize_v2(x, out_type='int8')
+    assert q.dtype == onp.int8
+    back = nd.dequantize(q, lo, hi)
+    assert onp.allclose(back.asnumpy(), x.asnumpy(), atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_dequantize_roundtrip_uint8():
+    x = nd.array(onp.random.RandomState(1).uniform(0, 5, (8, 8)).astype('float32'))
+    q, lo, hi = nd.quantize(x, float(x.asnumpy().min()),
+                            float(x.asnumpy().max()), out_type='uint8')
+    assert q.dtype == onp.uint8
+    back = nd.dequantize(q, lo, hi)
+    assert onp.allclose(back.asnumpy(), x.asnumpy(), atol=5.0 / 255 + 1e-6)
+
+
+def test_quantize_calibrated_range_clips():
+    x = nd.array(onp.array([[-10.0, 0.5, 10.0]], dtype='float32'))
+    q, lo, hi = nd.quantize_v2(x, out_type='int8', min_calib_range=-1.0,
+                               max_calib_range=1.0)
+    qn = q.asnumpy()
+    assert qn[0, 0] == -127 and qn[0, 2] == 127
+
+
+def test_quantized_fully_connected_matches_float():
+    rs = onp.random.RandomState(2)
+    x = rs.uniform(-1, 1, (5, 32)).astype('float32')
+    w = rs.uniform(-1, 1, (8, 32)).astype('float32')
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x), out_type='int8')
+    qw, wlo, whi = nd.quantize_v2(nd.array(w), out_type='int8')
+    out32, olo, ohi = nd.quantized_fully_connected(
+        qx, qw, None, xlo, xhi, wlo, whi, num_hidden=8, no_bias=True)
+    out = nd.dequantize(out32, olo, ohi).asnumpy()
+    ref = x @ w.T
+    assert onp.abs(out - ref).max() < 0.15
+
+
+def test_quantized_conv_matches_float():
+    rs = onp.random.RandomState(3)
+    x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype('float32')
+    w = rs.uniform(-1, 1, (4, 3, 3, 3)).astype('float32')
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x), out_type='int8')
+    qw, wlo, whi = nd.quantize_v2(nd.array(w), out_type='int8')
+    out32, olo, ohi = nd.quantized_conv(
+        qx, qw, None, xlo, xhi, wlo, whi, kernel=(3, 3), stride=(1, 1),
+        pad=(1, 1), num_filter=4, no_bias=True)
+    out = nd.dequantize(out32, olo, ohi).asnumpy()
+    ref = nd.convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         stride=(1, 1), pad=(1, 1), num_filter=4,
+                         no_bias=True).asnumpy()
+    assert onp.abs(out - ref).max() < 0.3
+
+
+def test_quantized_pooling_int8_domain():
+    rs = onp.random.RandomState(4)
+    x = rs.uniform(-1, 1, (1, 2, 4, 4)).astype('float32')
+    qx, lo, hi = nd.quantize_v2(nd.array(x), out_type='int8')
+    out, olo, ohi = nd.quantized_pooling(qx, lo, hi, kernel=(2, 2),
+                                         stride=(2, 2), pool_type='max')
+    ref = nd.pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type='max').asnumpy()
+    back = nd.dequantize(out, olo, ohi).asnumpy()
+    assert onp.abs(back - ref).max() < 2.0 / 127
+
+
+def test_requantize_int32_to_int8():
+    rs = onp.random.RandomState(5)
+    x = rs.uniform(-1, 1, (4, 16)).astype('float32')
+    w = rs.uniform(-1, 1, (8, 16)).astype('float32')
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x), out_type='int8')
+    qw, wlo, whi = nd.quantize_v2(nd.array(w), out_type='int8')
+    out32, olo, ohi = nd.quantized_fully_connected(
+        qx, qw, None, xlo, xhi, wlo, whi, num_hidden=8, no_bias=True)
+    q8, rlo, rhi = nd.requantize(out32, olo, ohi)
+    assert q8.dtype == onp.int8
+    back = nd.dequantize(q8, rlo, rhi).asnumpy()
+    ref = x @ w.T
+    assert onp.abs(back - ref).max() < 0.2
+
+
+def test_entropy_threshold_reasonable():
+    rs = onp.random.RandomState(6)
+    # heavy-tailed data: optimal threshold should be well below the max
+    arr = onp.concatenate([rs.normal(0, 1, 100000),
+                           onp.array([50.0, -50.0])]).astype('float32')
+    mn, mx_, th, div = _get_optimal_threshold(arr, num_bins=1001)
+    assert mn < 0 < mx_
+    assert th < 25.0
+    assert th > 1.0
+
+
+def _make_mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation='relu', in_units=20))
+    net.add(gluon.nn.Dense(10, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_quantize_net_naive_mlp_close_to_float():
+    rs = onp.random.RandomState(7)
+    net = _make_mlp()
+    calib = nd.array(rs.uniform(-1, 1, (16, 20)).astype('float32'))
+    qnet = quantize_net(net, calib_data=calib, calib_mode='naive')
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ['QuantizedDense', 'QuantizedDense']
+    x = nd.array(rs.uniform(-1, 1, (4, 20)).astype('float32'))
+    ref = net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    assert onp.abs(out - ref).max() < 0.25 * max(1.0, onp.abs(ref).max())
+    # original net untouched
+    assert all(type(c).__name__ == 'Dense' for c in net._children.values())
+
+
+def test_quantize_net_dynamic_mode():
+    rs = onp.random.RandomState(8)
+    net = _make_mlp()
+    qnet = quantize_net(net, calib_mode='none')
+    x = nd.array(rs.uniform(-1, 1, (4, 20)).astype('float32'))
+    ref = net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    assert onp.abs(out - ref).max() < 0.25 * max(1.0, onp.abs(ref).max())
+
+
+def test_quantize_net_entropy_and_hybridize():
+    rs = onp.random.RandomState(9)
+    net = _make_mlp()
+    calib = [nd.array(rs.uniform(-1, 1, (8, 20)).astype('float32'))
+             for _ in range(3)]
+    qnet = quantize_net(net, calib_data=calib, calib_mode='entropy',
+                        num_bins=501)
+    x = nd.array(rs.uniform(-1, 1, (4, 20)).astype('float32'))
+    out_eager = qnet(x).asnumpy()
+    qnet.hybridize()
+    out_hyb = qnet(x).asnumpy()
+    assert onp.allclose(out_eager, out_hyb, atol=1e-5)
+
+
+def test_quantize_net_conv_net():
+    rs = onp.random.RandomState(10)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3,
+                            activation='relu'))
+    net.add(gluon.nn.Conv2D(4, kernel_size=3, padding=1, in_channels=8))
+    net.initialize(mx.init.Xavier())
+    calib = nd.array(rs.uniform(-1, 1, (4, 3, 8, 8)).astype('float32'))
+    qnet = quantize_net(net, calib_data=calib, calib_mode='naive')
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ['QuantizedConv2D', 'QuantizedConv2D']
+    x = nd.array(rs.uniform(-1, 1, (2, 3, 8, 8)).astype('float32'))
+    ref = net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    assert onp.abs(out - ref).max() < 0.3 * max(1.0, onp.abs(ref).max())
+
+
+def test_quantize_net_exclude_layers():
+    net = _make_mlp()
+    calib = nd.array(onp.random.RandomState(11).uniform(
+        -1, 1, (8, 20)).astype('float32'))
+    qnet = quantize_net(net, calib_data=calib, calib_mode='naive',
+                        exclude_layers=['0'])
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ['Dense', 'QuantizedDense']
+
+
+def test_quantized_pooling_uint8():
+    rs = onp.random.RandomState(12)
+    x = rs.uniform(0, 5, (1, 2, 4, 4)).astype('float32')
+    q, lo, hi = nd.quantize(nd.array(x), 0.0, 5.0, out_type='uint8')
+    out, olo, ohi = nd.quantized_pooling(q, lo, hi, kernel=(2, 2),
+                                         stride=(2, 2), pool_type='max')
+    assert out.dtype == onp.uint8
+    ref = nd.pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type='max').asnumpy()
+    back = nd.dequantize(out, olo, ohi).asnumpy()
+    assert onp.abs(back - ref).max() < 5.0 / 255 + 1e-6
+    # avg pool of bright uint8 values must not clip at 127
+    bright = nd.array(onp.full((1, 1, 2, 2), 200, dtype='uint8'))
+    avg, _, _ = nd.quantized_pooling(bright, 0.0, 5.0, kernel=(2, 2),
+                                     stride=(2, 2), pool_type='avg')
+    assert int(avg.asnumpy().ravel()[0]) == 200
+
+
+def test_quantized_conv_scalar_args():
+    rs = onp.random.RandomState(13)
+    x = rs.uniform(-1, 1, (1, 2, 6, 6)).astype('float32')
+    w = rs.uniform(-1, 1, (3, 2, 3, 3)).astype('float32')
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x), out_type='int8')
+    qw, wlo, whi = nd.quantize_v2(nd.array(w), out_type='int8')
+    out32, olo, ohi = nd.quantized_conv(
+        qx, qw, None, xlo, xhi, wlo, whi, kernel=3, stride=1, pad=1,
+        num_filter=3, no_bias=True)
+    assert out32.shape == (1, 3, 6, 6)
+
+
+def test_quantized_net_save_load_roundtrip(tmp_path):
+    rs = onp.random.RandomState(14)
+    net = _make_mlp()
+    calib = nd.array(rs.uniform(-1, 1, (16, 20)).astype('float32'))
+    qnet = quantize_net(net, calib_data=calib, calib_mode='naive')
+    x = nd.array(rs.uniform(-1, 1, (4, 20)).astype('float32'))
+    ref = qnet(x).asnumpy()
+    fname = str(tmp_path / 'qnet.params')
+    qnet.save_parameters(fname)
+    # fresh conversion with different calibration, then load the saved state
+    other = quantize_net(net, calib_data=nd.array(
+        rs.uniform(-5, 5, (16, 20)).astype('float32')), calib_mode='naive')
+    assert not onp.allclose(other(x).asnumpy(), ref)
+    other.load_parameters(fname)
+    assert onp.allclose(other(x).asnumpy(), ref, atol=1e-6)
