@@ -27,6 +27,7 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import kvstore
+from . import kvstore as kv    # ref: python/mxnet/__init__.py `mx.kv` alias
 from .kvstore import create as _kv_create  # noqa: F401
 from . import io
 from . import recordio

@@ -134,7 +134,7 @@ class Parameter:
     def _init_grad(self):
         self._grad = []
         for d in self._data:
-            d.attach_grad(self._grad_req)
+            d.attach_grad(self._grad_req, stype=self._grad_stype)
             self._grad.append(d.grad)
 
     def _finish_deferred_init(self, shape=None):

@@ -179,8 +179,14 @@ class NDArray:
 
     # ---- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req='write', stype=None):
-        """Ref: python/mxnet/ndarray/ndarray.py attach_grad."""
-        self._grad = NDArray(jnp.zeros_like(self._data))
+        """Ref: python/mxnet/ndarray/ndarray.py attach_grad. A non-default
+        ``stype`` makes the gradient a real sparse NDArray so the sparse
+        API (indices/data/retain) and stype-dispatching optimizers work."""
+        grad = NDArray(jnp.zeros_like(self._data))
+        if stype not in (None, 'default'):
+            from .sparse import cast_storage
+            grad = cast_storage(grad, stype)
+        self._grad = grad
         self._grad_req = grad_req
         self._in_graph = True
 

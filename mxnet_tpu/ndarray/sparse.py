@@ -41,16 +41,12 @@ class CSRNDArray(BaseSparseNDArray):
 
     def _csr_parts(self):
         a = self.asnumpy()
-        indptr = [0]
-        indices = []
-        data = []
-        for row in a:
-            nz = onp.nonzero(row)[0]
-            indices.extend(nz.tolist())
-            data.extend(row[nz].tolist())
-            indptr.append(len(indices))
-        return (onp.array(data, a.dtype), onp.array(indices, onp.int64),
-                onp.array(indptr, onp.int64))
+        rows, cols = onp.nonzero(a)
+        data = a[rows, cols]
+        counts = onp.bincount(rows, minlength=a.shape[0])
+        indptr = onp.concatenate([[0], onp.cumsum(counts)])
+        return (data.astype(a.dtype), cols.astype(onp.int64),
+                indptr.astype(onp.int64))
 
     @property
     def data(self):
@@ -109,9 +105,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype='float32'):
         indptr = onp.asarray(indptr if not isinstance(indptr, NDArray)
                              else indptr.asnumpy(), onp.int64)
         dense = onp.zeros(shape, dtype=dtype)
-        for r in range(shape[0]):
-            for j in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[j]] = data[j]
+        rows = onp.repeat(onp.arange(shape[0]), onp.diff(indptr))
+        dense[rows, indices] = data
         return CSRNDArray(jnp.asarray(dense))
     src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
     return CSRNDArray(jnp.asarray(src.astype(dtype)))

@@ -32,19 +32,39 @@ def _grad_prep(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     return g
 
 
+def _row_mask(grad):
+    """Rows of a row-sparse gradient that are actually present. The dense
+    payload loses explicit indices, so presence == any nonzero in the row
+    (ref: the FComputeEx lazy paths key off grad.aux_data(kIdx))."""
+    axes = tuple(range(1, grad.ndim))
+    present = (grad != 0).any(axis=axes) if axes else (grad != 0)
+    return present.reshape((-1,) + (1,) * (grad.ndim - 1))
+
+
 @_reg
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
-               clip_gradient=-1.0, lazy_update=True):
+               clip_gradient=-1.0, lazy_update=False):
+    """lazy_update: only rows with a present (nonzero) row-sparse gradient
+    are updated (ref: sgd_update FComputeEx in optimizer_op.cc); callers
+    enable it only when grad.stype == 'row_sparse'."""
     g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
-    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+    new_w = (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+    if lazy_update:
+        new_w = jnp.where(_row_mask(grad), new_w, weight)
+    return new_w
 
 
 @_reg
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
-                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
     g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - lr * g
-    return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
+    new_w = (weight.astype(jnp.float32) + new_mom).astype(weight.dtype)
+    if lazy_update:
+        mask = _row_mask(grad)
+        new_w = jnp.where(mask, new_w, weight)
+        new_mom = jnp.where(mask, new_mom, mom)
+    return new_w, new_mom
 
 
 @_reg
@@ -76,12 +96,18 @@ def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 @_reg
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
-                lazy_update=True):
+                lazy_update=False):
     g = _grad_prep(grad, rescale_grad, clip_gradient, wd, weight)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     new_w = weight.astype(jnp.float32) - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
-    return new_w.astype(weight.dtype), new_mean, new_var
+    new_w = new_w.astype(weight.dtype)
+    if lazy_update:
+        mask = _row_mask(grad)
+        new_w = jnp.where(mask, new_w, weight)
+        new_mean = jnp.where(mask, new_mean, mean)
+        new_var = jnp.where(mask, new_var, var)
+    return new_w, new_mean, new_var
 
 
 @_reg

@@ -47,8 +47,14 @@ def _scalar(x):
     return jnp.float32(x)
 
 
+def _rng(x):
+    """Range argument that may be a scalar (tensor-wise) or a per-channel
+    vector (channel-wise weight quantization)."""
+    return jnp.asarray(x, jnp.float32)
+
+
 def int8_scale(min_range, max_range):
-    amax = jnp.maximum(jnp.abs(_scalar(min_range)), jnp.abs(_scalar(max_range)))
+    amax = jnp.maximum(jnp.abs(_rng(min_range)), jnp.abs(_rng(max_range)))
     return INT8_RANGE / jnp.maximum(amax, 1e-30)
 
 
@@ -84,8 +90,9 @@ def quantize_v2(data, out_type='int8', min_calib_range=None,
 
 @_reg
 def dequantize(data, min_range, max_range, out_type='float32'):
-    """Ref: dequantize.cc."""
-    lo, hi = _scalar(min_range), _scalar(max_range)
+    """Ref: dequantize.cc. Ranges broadcast against ``data``, so per-channel
+    int32 accumulator ranges (channel-wise weights) dequantize correctly."""
+    lo, hi = _rng(min_range), _rng(max_range)
     if data.dtype == jnp.uint8:
         scale = UINT8_RANGE / jnp.maximum(hi - lo, 1e-30)
         return (data.astype(jnp.float32) / scale + lo).astype(out_type)
@@ -99,10 +106,12 @@ def dequantize(data, min_range, max_range, out_type='float32'):
 @_regn(3)
 def requantize(data, min_range, max_range, min_calib_range=None,
                max_calib_range=None):
-    """int32 -> int8 rescale (ref: requantize.cc)."""
+    """int32 -> int8 rescale (ref: requantize.cc). Accepts per-channel
+    accumulator ranges (reduced to one output scale)."""
     f = dequantize(data, min_range, max_range)
     if min_calib_range is not None and max_calib_range is not None:
-        lo, hi = _scalar(min_calib_range), _scalar(max_calib_range)
+        lo = jnp.min(_rng(min_calib_range))
+        hi = jnp.max(_rng(max_calib_range))
     else:
         lo = jnp.min(f)
         hi = jnp.max(f)
@@ -111,7 +120,8 @@ def requantize(data, min_range, max_range, min_calib_range=None,
 
 def _mul_out_range(min_d, max_d, min_w, max_w):
     """Float range represented by the int32 accumulator
-    (ref: quantization_utils.h quantization_range_for_multiplication)."""
+    (ref: quantization_utils.h quantization_range_for_multiplication).
+    ``min_w``/``max_w`` may be per-output-channel vectors."""
     sd = int8_scale(min_d, max_d)
     sw = int8_scale(min_w, max_w)
     amax = INT32_RANGE / (sd * sw)
@@ -159,6 +169,10 @@ def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=jnp.int32)
     lo, hi, sd, sw = _mul_out_range(min_data, max_data, min_weight, max_weight)
+    if getattr(lo, 'ndim', 0):
+        # per-channel ranges must broadcast over the NCHW channel axis
+        lo = lo.reshape((-1,) + (1,) * nd)
+        hi = hi.reshape((-1,) + (1,) * nd)
     if bias is not None and not no_bias:
         sb = int8_scale(min_bias, max_bias)
         bias32 = jnp.round(bias.astype(jnp.float32) / sb * (sd * sw))
@@ -193,14 +207,21 @@ def quantized_pooling(data, min_data, max_data, kernel=None, stride=None,
         for k in kernel:
             n *= k
         out = jnp.clip(jnp.round(s / n), info.min, info.max).astype(data.dtype)
-    return out, _scalar(min_data), _scalar(max_data)
+    return out, _rng(min_data), _rng(max_data)
 
 
 @_regn(3)
 def quantized_flatten(data, min_data, max_data):
-    """Ref: quantized_flatten.cc."""
-    return (data.reshape(data.shape[0], -1), _scalar(min_data),
-            _scalar(max_data))
+    """Ref: quantized_flatten.cc. Per-channel ranges are reduced to one
+    scale: flattening mixes channels, so a vector range no longer maps to
+    an axis of the output."""
+    lo, hi = _rng(min_data), _rng(max_data)
+    return data.reshape(data.shape[0], -1), jnp.min(lo), jnp.max(hi)
+
+
+def _abs_max(lo, hi):
+    """Largest magnitude an input's (possibly per-channel) range spans."""
+    return jnp.maximum(jnp.abs(_rng(lo)), jnp.abs(_rng(hi))).max()
 
 
 @_regn(3)
@@ -209,14 +230,14 @@ def quantized_concat(*args, dim=1):
     (ref: quantized_concat.cc). Args: d0, min0, max0, d1, min1, max1, ..."""
     n = len(args) // 3
     datas = args[0::3][:n]
-    mins = [_scalar(a) for a in args[1::3][:n]]
-    maxs = [_scalar(a) for a in args[2::3][:n]]
-    amax = jnp.stack([jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    mins = list(args[1::3][:n])
+    maxs = list(args[2::3][:n])
+    amax = jnp.stack([_abs_max(lo, hi)
                       for lo, hi in zip(mins, maxs)]).max()
+    s_out = INT8_RANGE / amax
     parts = []
     for d, lo, hi in zip(datas, mins, maxs):
-        s_in = int8_scale(lo, hi)
-        s_out = INT8_RANGE / amax
+        s_in = int8_scale(lo, hi)   # may be per-channel; broadcasts below
         parts.append(jnp.clip(jnp.round(d.astype(jnp.float32) / s_in * s_out),
                               -127, 127).astype(jnp.int8))
     return jnp.concatenate(parts, axis=dim), -amax, amax
@@ -229,9 +250,7 @@ def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
     fl = dequantize(lhs, min_lhs, max_lhs)
     fr = dequantize(rhs, min_rhs, max_rhs)
     out = fl + fr
-    amax = (jnp.maximum(jnp.abs(_scalar(min_lhs)), jnp.abs(_scalar(max_lhs)))
-            + jnp.maximum(jnp.abs(_scalar(min_rhs)),
-                          jnp.abs(_scalar(max_rhs))))
+    amax = _abs_max(min_lhs, max_lhs) + _abs_max(min_rhs, max_rhs)
     s = INT8_RANGE / jnp.maximum(amax, 1e-30)
     q = jnp.clip(jnp.round(out * s), -127, 127).astype(jnp.int8)
     return q, -amax, amax

@@ -183,17 +183,21 @@ class SGD(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        # lazy semantics apply only to row-sparse gradients (ref:
+        # optimizer.py:526 SGD docstring; FComputeEx dispatch on stype)
+        lazy = self.lazy_update and grad.stype == 'row_sparse'
         if state is not None:
             new_w, new_mom = _invoke(
                 O.sgd_mom_update, weight, grad, state, lr=lr,
                 momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
-                clip_gradient=_cg(self.clip_gradient))
+                clip_gradient=_cg(self.clip_gradient), lazy_update=lazy)
             weight._data = new_w._data
             state._data = new_mom._data
         else:
             new_w = _invoke(O.sgd_update, weight, grad, lr=lr, wd=wd,
                             rescale_grad=self.rescale_grad,
-                            clip_gradient=_cg(self.clip_gradient))
+                            clip_gradient=_cg(self.clip_gradient),
+                            lazy_update=lazy)
             weight._data = new_w._data
 
 
@@ -404,11 +408,12 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        lazy = self.lazy_update and grad.stype == 'row_sparse'
         new_w, new_mean, new_var = _invoke(
             O.adam_update, weight, grad, mean, var, lr=lr_t, beta1=self.beta1,
             beta2=self.beta2, epsilon=self.epsilon, wd=wd,
             rescale_grad=self.rescale_grad,
-            clip_gradient=_cg(self.clip_gradient))
+            clip_gradient=_cg(self.clip_gradient), lazy_update=lazy)
         weight._data = new_w._data
         mean._data, var._data = new_mean._data, new_var._data
 

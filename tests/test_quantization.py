@@ -219,3 +219,84 @@ def test_quantized_net_save_load_roundtrip(tmp_path):
     assert not onp.allclose(other(x).asnumpy(), ref)
     other.load_parameters(fname)
     assert onp.allclose(other(x).asnumpy(), ref, atol=1e-6)
+
+
+def test_quantize_net_channel_wise_beats_tensor_wise():
+    rs = onp.random.RandomState(15)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3))
+    net.initialize(mx.init.Xavier())
+    # make filter magnitudes wildly uneven: per-tensor scale wastes int8 range
+    w = net._children['0'].weight.data().asnumpy().copy()
+    w[0] *= 50.0
+    net._children['0'].weight.set_data(nd.array(w))
+    calib = nd.array(rs.uniform(-1, 1, (4, 3, 8, 8)).astype('float32'))
+    x = nd.array(rs.uniform(-1, 1, (2, 3, 8, 8)).astype('float32'))
+    ref = net(x).asnumpy()
+    qt = quantize_net(net, calib_data=calib, calib_mode='naive')(x).asnumpy()
+    qc = quantize_net(net, calib_data=calib, calib_mode='naive',
+                      quantize_granularity='channel-wise')(x).asnumpy()
+    # channel 0's error is dominated by (inherent) activation quantization;
+    # the tensor-wise scale crushes the other channels' weights to ~0 while
+    # channel-wise recovers them
+    err_t = onp.abs(qt - ref)[:, 1:].max()
+    err_c = onp.abs(qc - ref)[:, 1:].max()
+    assert err_c < err_t * 0.2, (err_t, err_c)
+
+
+def test_quantize_net_rejects_bad_args():
+    net = _make_mlp()
+    with pytest.raises(ValueError):
+        quantize_net(net, calib_mode='none', quantize_granularity='block')
+    with pytest.raises(TypeError):
+        quantize_net(net, calib_mode='none', num_calib_batchs=3)  # typo
+
+
+def test_quantize_net_inplace_fallback_clears_cached_op(monkeypatch):
+    import types
+    import mxnet_tpu.contrib.quantization as qmod
+    rs = onp.random.RandomState(16)
+    net = _make_mlp()
+    net.hybridize()
+    x = nd.array(rs.uniform(-1, 1, (4, 20)).astype('float32'))
+    net(x)  # populate the compiled cache with the float graph
+    def boom(*a, **k):
+        raise TypeError("not deepcopyable")
+    monkeypatch.setattr(qmod, 'copy', types.SimpleNamespace(deepcopy=boom))
+    qnet = quantize_net(net, calib_mode='none')
+    assert qnet is net  # converted in place
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ['QuantizedDense', 'QuantizedDense']
+    # the old float executable must not be reused
+    out = qnet(x).asnumpy()
+    assert out.shape == (4, 10)
+
+
+def test_channel_wise_ranges_flow_through_int8_ops():
+    """Per-channel conv output ranges compose with pooling/requantize/
+    concat/add without leaving the quantized domain."""
+    rs = onp.random.RandomState(17)
+    x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype('float32')
+    w = rs.uniform(-1, 1, (4, 3, 3, 3)).astype('float32')
+    w[0] *= 20.0
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x), out_type='int8')
+    # channel-wise weight ranges
+    amax = onp.abs(w).reshape(4, -1).max(axis=1)
+    qw = nd.array(onp.clip(onp.round(
+        w * (127.0 / amax).reshape(4, 1, 1, 1)), -127, 127).astype('int8'))
+    wlo, whi = nd.array(-amax), nd.array(amax)
+    out32, olo, ohi = nd.quantized_conv(
+        qx, qw, None, xlo, xhi, wlo, whi, kernel=(3, 3), pad=(1, 1),
+        num_filter=4, no_bias=True)
+    assert olo.shape == (4, 1, 1)
+    q8, rlo, rhi = nd.requantize(out32, olo, ohi)
+    p, plo, phi = nd.quantized_pooling(q8, rlo, rhi, kernel=(2, 2),
+                                       stride=(2, 2), pool_type='max')
+    c, clo, chi = nd.quantized_concat(p, plo, phi, p, plo, phi, dim=1)
+    a, alo, ahi = nd.quantized_elemwise_add(p, p, plo, phi, plo, phi)
+    f, flo, fhi = nd.quantized_flatten(p, plo, phi)
+    ref = nd.pooling(nd.convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                                    pad=(1, 1), num_filter=4, no_bias=True),
+                     kernel=(2, 2), stride=(2, 2), pool_type='max').asnumpy()
+    back = nd.dequantize(p, plo, phi).asnumpy()
+    assert onp.abs(back - ref).max() < 0.05 * max(1.0, onp.abs(ref).max())

@@ -1,0 +1,164 @@
+"""Sparse NDArray + sparse training tests
+(ref: tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py,
+tests for lazy_update in test_optimizer.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_sparse(shape, density, rs):
+    a = rs.uniform(-1, 1, shape).astype('float32')
+    a[rs.uniform(0, 1, shape) > density] = 0
+    return a
+
+
+def test_csr_parts_roundtrip():
+    rs = onp.random.RandomState(0)
+    a = _rand_sparse((7, 11), 0.3, rs)
+    csr = sp.csr_matrix(a)
+    data, indices, indptr = (csr.data.asnumpy(), csr.indices.asnumpy(),
+                             csr.indptr.asnumpy())
+    rebuilt = sp.csr_matrix((data, indices, indptr), shape=a.shape)
+    assert onp.allclose(rebuilt.asnumpy(), a)
+    assert rebuilt.stype == 'csr'
+    # indptr is monotone and counts all nonzeros
+    assert indptr[0] == 0 and indptr[-1] == (a != 0).sum()
+    assert (onp.diff(indptr) >= 0).all()
+
+
+def test_csr_empty_rows():
+    a = onp.zeros((4, 5), dtype='float32')
+    a[2, 3] = 2.5
+    csr = sp.csr_matrix(a)
+    assert onp.allclose(csr.indptr.asnumpy(), [0, 0, 0, 1, 1])
+    assert csr.indices.asnumpy().tolist() == [3]
+
+
+def test_row_sparse_roundtrip():
+    rs = onp.random.RandomState(1)
+    data = rs.uniform(-1, 1, (3, 4)).astype('float32')
+    indices = onp.array([1, 4, 6])
+    rsp = sp.row_sparse_array((data, indices), shape=(8, 4))
+    assert rsp.stype == 'row_sparse'
+    assert rsp.indices.asnumpy().tolist() == [1, 4, 6]
+    assert onp.allclose(rsp.data.asnumpy(), data)
+    dense = rsp.tostype('default')
+    assert dense.stype == 'default'
+    assert onp.allclose(dense.asnumpy()[indices], data)
+
+
+def test_retain():
+    rs = onp.random.RandomState(2)
+    a = rs.uniform(1, 2, (6, 3)).astype('float32')
+    rsp = sp.row_sparse_array(a)
+    kept = sp.retain(rsp, nd.array(onp.array([0, 5])))
+    out = kept.asnumpy()
+    assert onp.allclose(out[[0, 5]], a[[0, 5]])
+    assert (out[1:5] == 0).all()
+
+
+def test_sparse_dot_matches_dense():
+    rs = onp.random.RandomState(3)
+    a = _rand_sparse((5, 8), 0.4, rs)
+    b = rs.uniform(-1, 1, (8, 3)).astype('float32')
+    out = sp.dot(sp.csr_matrix(a), nd.array(b))
+    assert onp.allclose(out.asnumpy(), a @ b, atol=1e-5)
+
+
+def test_density():
+    a = onp.zeros((4, 4), dtype='float32')
+    a[0, 0] = 1
+    assert abs(sp.csr_matrix(a).density - 1 / 16) < 1e-9
+
+
+def test_lazy_sgd_mom_skips_absent_rows():
+    rs = onp.random.RandomState(4)
+    w0 = rs.uniform(-1, 1, (6, 4)).astype('float32')
+    g = onp.zeros((6, 4), dtype='float32')
+    g[[1, 3]] = rs.uniform(-1, 1, (2, 4))
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           lazy_update=True)
+    # row_sparse grad: absent rows untouched (weight AND momentum)
+    w = nd.array(w0.copy())
+    grad = sp.RowSparseNDArray(nd.array(g)._data)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    assert onp.allclose(wn[[0, 2, 4, 5]], w0[[0, 2, 4, 5]])
+    assert not onp.allclose(wn[[1, 3]], w0[[1, 3]])
+    assert (state.asnumpy()[[0, 2, 4, 5]] == 0).all()
+
+    # dense grad with identical values: every row updated (wd decay applies)
+    w2 = nd.array(w0.copy())
+    state2 = opt.create_state(1, w2)
+    opt.update(1, w2, nd.array(g), state2)
+    assert not onp.allclose(w2.asnumpy()[[0, 2]], w0[[0, 2]])
+
+
+def test_lazy_adam_state_frozen_for_absent_rows():
+    rs = onp.random.RandomState(5)
+    w0 = rs.uniform(-1, 1, (5, 3)).astype('float32')
+    g = onp.zeros((5, 3), dtype='float32')
+    g[0] = 1.0
+
+    opt = mx.optimizer.Adam(learning_rate=0.05, lazy_update=True)
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    grad = sp.RowSparseNDArray(nd.array(g)._data)
+    for _ in range(3):
+        opt.update(0, w, grad, state)
+    mean, var = state
+    assert onp.allclose(w.asnumpy()[1:], w0[1:])
+    assert (mean.asnumpy()[1:] == 0).all()
+    assert (var.asnumpy()[1:] == 0).all()
+    assert not onp.allclose(w.asnumpy()[0], w0[0])
+
+
+def test_embedding_sparse_grad_end_to_end():
+    """Embedding with sparse_grad trains only touched rows under lazy SGD
+    (ref: test_module.py sparse embedding tests)."""
+    vocab, dim = 10, 4
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), 'sgd',
+                            {'learning_rate': 0.5, 'momentum': 0.9})
+    x = nd.array(onp.array([1, 3, 3], dtype='float32'))
+    with autograd.record():
+        y = emb(x)
+        loss = (y * y).sum()
+    loss.backward()
+    assert emb.weight.grad().stype == 'row_sparse'
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    untouched = [i for i in range(vocab) if i not in (1, 3)]
+    assert onp.allclose(w1[untouched], w0[untouched])
+    assert not onp.allclose(w1[[1, 3]], w0[[1, 3]])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create('local')
+    rs = onp.random.RandomState(6)
+    a = rs.uniform(-1, 1, (8, 3)).astype('float32')
+    kv.init('w', sp.row_sparse_array(a))
+    out = sp.zeros('row_sparse', (8, 3))
+    kv.row_sparse_pull('w', out=out, row_ids=nd.array(onp.array([2, 5])))
+    got = out.asnumpy()
+    assert onp.allclose(got[[2, 5]], a[[2, 5]], atol=1e-6)
+    assert (got[[0, 1, 3, 4, 6, 7]] == 0).all()
+
+
+def test_sparse_grad_is_row_sparse_ndarray():
+    emb = gluon.nn.Embedding(6, 3, sparse_grad=True)
+    emb.initialize(mx.init.Normal(0.1))
+    x = nd.array(onp.array([0, 2], dtype='float32'))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, sp.RowSparseNDArray)
+    assert g.stype == 'row_sparse'
+    assert sorted(g.indices.asnumpy().tolist()) == [0, 2]
