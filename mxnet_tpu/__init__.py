@@ -47,6 +47,7 @@ from . import visualization as viz
 from . import parallel
 from . import amp
 from . import contrib
+from . import operator
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
            'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError']

@@ -18,3 +18,10 @@ from .utils import split_data, split_and_load  # noqa: F401
 # e.g. creation ops which have ctx-aware python front-ends here)
 _register.populate(globals(), skip=('zeros', 'ones', 'full', 'arange',
                                     'concat', 'stack'))
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a Python custom op registered via mx.operator.register
+    (ref: src/operator/custom/custom.cc NNVM_REGISTER_OP(Custom))."""
+    from ..operator import invoke_custom
+    return invoke_custom(inputs, op_type=op_type, **kwargs)

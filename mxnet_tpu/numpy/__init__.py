@@ -176,7 +176,24 @@ _FUNCS = [
     'bincount', 'percentile', 'quantile', 'median', 'average', 'cov',
     'corrcoef', 'convolve', 'correlate', 'gradient', 'diff', 'ediff1d',
     'cross', 'kron', 'vdot', 'pad', 'insert', 'delete', 'append', 'resize',
-    'trim_zeros', 'tril_indices', 'polyval', 'vander',
+    'trim_zeros', 'tril_indices', 'triu_indices', 'diag_indices',
+    'polyval', 'vander',
+    # nan-aware reductions
+    'nansum', 'nanprod', 'nanmean', 'nanstd', 'nanvar', 'nanmin', 'nanmax',
+    'nanargmin', 'nanargmax', 'nancumsum', 'nancumprod', 'nanmedian',
+    'nanpercentile', 'nanquantile',
+    # float manipulation / classification
+    'heaviside', 'ldexp', 'frexp', 'modf', 'divmod', 'copysign', 'nextafter',
+    'signbit', 'logaddexp', 'logaddexp2', 'exp2', 'fmax', 'fmin', 'fmod',
+    'isposinf', 'isneginf', 'iscomplex', 'isreal', 'positive', 'deg2rad',
+    'rad2deg', 'sinc', 'i0', 'ptp', 'digitize',
+    # complex views
+    'real', 'imag', 'conj', 'conjugate', 'angle',
+    # set routines / index helpers
+    'setdiff1d', 'union1d', 'intersect1d', 'isin', 'in1d', 'flatnonzero',
+    'argwhere', 'extract', 'select', 'unravel_index', 'ravel_multi_index',
+    'apply_along_axis', 'apply_over_axes', 'polyfit', 'asarray', 'copy',
+    'shape', 'ndim', 'size', 'iterable', 'packbits', 'unpackbits',
 ]
 
 for _f in _FUNCS:
@@ -186,6 +203,25 @@ for _f in _FUNCS:
 
 def fix(x):
     return ndarray(jnp.trunc(_unwrap(x)))
+
+
+finfo = jnp.finfo
+iinfo = jnp.iinfo
+
+# dtype-valued functions must not be wrapped into ndarray (np.dtype has a
+# .shape attribute, which would fool the generic wrapper)
+result_type = jnp.result_type
+promote_types = jnp.promote_types
+can_cast = jnp.can_cast
+
+
+def in1d(ar1, ar2, invert=False):
+    return ndarray(jnp.isin(jnp.ravel(_unwrap(ar1)), _unwrap(ar2),
+                            invert=invert))
+
+
+def ascontiguousarray(a, dtype=None):
+    return array(a, dtype=dtype)
 
 pi = _onp.pi
 e = _onp.e

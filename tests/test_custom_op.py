@@ -1,0 +1,180 @@
+"""Python custom-op API tests (ref: tests/python/unittest/test_operator.py
+test_custom_op; python/mxnet/operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register('t_sigmoid')
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(1 / (1 + onp.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+def test_custom_forward_backward():
+    x = nd.array([0.0, 1.0, -2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type='t_sigmoid')
+        loss = (y * 2).sum()
+    loss.backward()
+    s = 1 / (1 + onp.exp(-onp.array([0.0, 1.0, -2.0])))
+    assert_almost_equal(y, s, rtol=1e-6)
+    assert_almost_equal(x.grad, 2 * s * (1 - s), rtol=1e-5)
+
+
+@mx.operator.register('t_addn')
+class _AddNProp(mx.operator.CustomOpProp):
+    def __init__(self, n='2'):
+        super().__init__(need_top_grad=True)
+        self.n = int(n)
+
+    def list_arguments(self):
+        return [f'in{i}' for i in range(self.n)]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _AddN()
+
+
+class _AddN(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        acc = in_data[0]
+        for a in in_data[1:]:
+            acc = acc + a
+        self.assign(out_data[0], req[0], acc)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i], out_grad[0])
+
+
+def test_custom_multi_input_kwargs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    c = nd.array([5.0, 6.0])
+    for arr in (a, b, c):
+        arr.attach_grad()
+    with autograd.record():
+        y = nd.Custom(a, b, c, op_type='t_addn', n=3)
+        y.backward()
+    assert_almost_equal(y, onp.array([9.0, 12.0]))
+    for arr in (a, b, c):
+        assert_almost_equal(arr.grad, onp.ones(2))
+
+
+def test_custom_composes_with_builtin_ops():
+    x = nd.array([[1.0, -1.0], [0.5, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        h = nd.dot(x, x)                       # builtin
+        y = nd.Custom(h, op_type='t_sigmoid')  # custom in the middle
+        loss = y.sum()
+    loss.backward()
+    # numeric gradient check
+    eps = 1e-3
+    x0 = x.asnumpy()
+    num = onp.zeros_like(x0)
+    for i in range(2):
+        for j in range(2):
+            xp, xm = x0.copy(), x0.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            f = lambda a: (1 / (1 + onp.exp(-(a @ a)))).sum()
+            num[i, j] = (f(xp) - f(xm)) / (2 * eps)
+    assert_almost_equal(x.grad, num, rtol=1e-2, atol=1e-3)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(ValueError):
+        nd.Custom(nd.array([1.0]), op_type='no_such_op')
+
+
+def test_registry_listing():
+    assert 't_sigmoid' in mx.operator.list_registered_ops()
+
+
+@mx.operator.register('t_swish')
+class _SwishProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Swish()
+
+
+class _Swish(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], x * nd.sigmoid(x))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0]
+        s = nd.sigmoid(x)
+        self.assign(in_grad[0], req[0], out_grad[0] * (s + x * s * (1 - s)))
+
+
+def test_custom_op_hybridized():
+    """Custom op inside a jitted trace via the pure_callback bridge."""
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc = gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return nd.Custom(self.fc(x), op_type='t_swish')
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.randn(2, 3).astype(onp.float32))
+    x.attach_grad()
+    eager = net(x).asnumpy()
+    net.hybridize()
+    with autograd.record():
+        y = net(x)
+        y.sum().backward()
+    assert_almost_equal(y, eager, rtol=1e-5, atol=1e-5)
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all() and (g != 0).any()
+
+
+@mx.operator.register('t_twoout')
+class _TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ['a', 'b']
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TwoOut()
+
+
+class _TwoOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 2)
+        self.assign(out_data[1], req[1], in_data[0] * 3)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * 2 + out_grad[1] * 3)
+
+
+def test_custom_multi_output_default_shapes():
+    """Default infer_shape yields one shape per declared output."""
+    a, b = nd.Custom(nd.array([1.0, 2.0]), op_type='t_twoout')
+    assert_almost_equal(a, onp.array([2.0, 4.0]))
+    assert_almost_equal(b, onp.array([3.0, 6.0]))

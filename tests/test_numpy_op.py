@@ -1,0 +1,67 @@
+"""mx.np frontend checks against numpy (ref:
+tests/python/unittest/test_numpy_op.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_nan_reductions():
+    x = onp.array([[1.0, onp.nan, 3.0], [4.0, 5.0, onp.nan]], onp.float32)
+    m = mnp.array(x)
+    assert_almost_equal(mnp.nansum(m), onp.nansum(x))
+    assert_almost_equal(mnp.nanmean(m, axis=1), onp.nanmean(x, axis=1))
+    assert_almost_equal(mnp.nanmax(m, axis=0), onp.nanmax(x, axis=0))
+    assert_almost_equal(mnp.nanstd(m), onp.nanstd(x), rtol=1e-5)
+
+
+def test_float_manipulation():
+    x = onp.array([-1.5, 0.0, 2.5], onp.float32)
+    m = mnp.array(x)
+    assert_almost_equal(mnp.copysign(mnp.ones(3), m), onp.copysign(onp.ones(3), x))
+    assert_almost_equal(mnp.logaddexp(m, m), onp.logaddexp(x, x), rtol=1e-6)
+    assert_almost_equal(mnp.heaviside(m, mnp.array(0.5)), onp.heaviside(x, 0.5))
+    assert_almost_equal(mnp.fmax(m, mnp.zeros(3)), onp.fmax(x, 0))
+    assert bool(mnp.isposinf(mnp.array([onp.inf]))[0].item())
+    assert_almost_equal(mnp.real(m), x)
+    assert_almost_equal(mnp.conj(m), x)
+
+
+def test_index_and_set_routines():
+    x = onp.array([3, 1, 2, 3], onp.int32)
+    m = mnp.array(x)
+    assert_almost_equal(mnp.unique(m), onp.unique(x))
+    r, c = mnp.unravel_index(mnp.array([5]), (2, 3))
+    assert r.item() == 1 and c.item() == 2
+    assert_almost_equal(mnp.flatnonzero(mnp.array([0, 2, 0, 3])),
+                        onp.flatnonzero(onp.array([0, 2, 0, 3])))
+    assert bool(mnp.isin(mnp.array([2]), m)[0].item())
+
+
+def test_einsum_tensordot():
+    a = onp.random.rand(3, 4).astype(onp.float32)
+    b = onp.random.rand(4, 5).astype(onp.float32)
+    assert_almost_equal(mnp.einsum('ij,jk->ik', mnp.array(a), mnp.array(b)),
+                        onp.einsum('ij,jk->ik', a, b), rtol=1e-5)
+    assert_almost_equal(mnp.tensordot(mnp.array(a), mnp.array(b), axes=1),
+                        onp.tensordot(a, b, axes=1), rtol=1e-5)
+
+
+def test_linalg_namespace():
+    a = onp.random.rand(4, 4).astype(onp.float32)
+    a = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    inv = mnp.linalg.inv(mnp.array(a))
+    assert_almost_equal(mnp.matmul(mnp.array(a), inv), onp.eye(4),
+                        rtol=1e-3, atol=1e-3)
+    w, v = mnp.linalg.eigh(mnp.array(a))
+    assert_almost_equal(onp.sort(w.asnumpy()), onp.sort(onp.linalg.eigh(a)[0]),
+                        rtol=1e-4)
+
+
+def test_interop_with_nd():
+    m = mnp.array([[1.0, 2.0]])
+    n = m.as_nd_ndarray()
+    assert type(n).__name__ == 'NDArray'
+    back = n.as_np_ndarray() if hasattr(n, 'as_np_ndarray') else mnp.array(n)
+    assert_almost_equal(back, onp.array([[1.0, 2.0]]))
