@@ -1,0 +1,5 @@
+"""mx.image — image loading/augmentation (ref: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import image  # noqa: F401
+from . import detection  # noqa: F401

@@ -215,7 +215,8 @@ promote_types = jnp.promote_types
 can_cast = jnp.can_cast
 
 
-def in1d(ar1, ar2, invert=False):
+def in1d(ar1, ar2, assume_unique=False, invert=False):
+    del assume_unique  # no fast path to pick; results are identical
     return ndarray(jnp.isin(jnp.ravel(_unwrap(ar1)), _unwrap(ar2),
                             invert=invert))
 

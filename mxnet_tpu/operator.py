@@ -235,10 +235,16 @@ def invoke_custom(inputs, op_type: Optional[str] = None, **kwargs):
                 for s, t in zip(out_shapes, out_types)]
 
     is_train = state.is_training
-    op.forward(is_train=is_train, req=['write'] * len(out_data),
-               in_data=in_data, out_data=out_data, aux=aux)
-
-    recording = state.is_recording and any(a._in_graph for a in in_data)
+    rec = state.is_recording
+    recording = rec and any(a._in_graph for a in in_data)
+    # the op's own backward is the gradient; internal nd ops inside the
+    # user's forward must not land on the tape
+    state.is_recording = False
+    try:
+        op.forward(is_train=is_train, req=['write'] * len(out_data),
+                   in_data=in_data, out_data=out_data, aux=aux)
+    finally:
+        state.is_recording = rec
     if recording:
         need_top = prop.need_top_grad_
 
@@ -246,9 +252,13 @@ def invoke_custom(inputs, op_type: Optional[str] = None, **kwargs):
             cts = ct_struct if isinstance(ct_struct, tuple) else (ct_struct,)
             out_grad = [_wrap(c) for c in cts] if need_top else []
             in_grad = [_wrap(jnp.zeros_like(a._data)) for a in in_data]
-            op.backward(req=['write'] * len(in_grad), out_grad=out_grad,
-                        in_data=in_data, out_data=out_data, in_grad=in_grad,
-                        aux=aux)
+            brec, state.is_recording = state.is_recording, False
+            try:
+                op.backward(req=['write'] * len(in_grad), out_grad=out_grad,
+                            in_data=in_data, out_data=out_data,
+                            in_grad=in_grad, aux=aux)
+            finally:
+                state.is_recording = brec
             return tuple(g._data for g in in_grad)
 
         _imperative.record_node(in_data, out_data, vjp_fn, fn=None,

@@ -1,0 +1,123 @@
+"""mx.image tests (ref: tests/python/unittest/test_image.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.fixture(scope='module')
+def rec_dataset(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('imgs')
+    rec = str(tmp / 'data.rec')
+    idx = str(tmp / 'data.idx')
+    rng = onp.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, rec, 'w')
+    for i in range(10):
+        img = (rng.rand(40, 50, 3) * 255).astype(onp.uint8)
+        w.write_idx(i, recordio.pack_img((0, float(i % 3), i, 0), img))
+    w.close()
+    return rec, idx
+
+
+def test_imdecode_imresize_roundtrip(tmp_path):
+    img = (onp.random.rand(24, 32, 3) * 255).astype(onp.uint8)
+    buf = recordio.pack_img((0, 0.0, 0, 0), img, img_fmt='.png')
+    _, payload = recordio.unpack(buf)
+    dec = image.imdecode(payload)
+    assert dec.shape == (24, 32, 3)
+    assert_almost_equal(dec, img)  # png is lossless
+    small = image.imresize(dec, 16, 12)
+    assert small.shape == (12, 16, 3)
+
+
+def test_crop_helpers():
+    img = mx.nd.array((onp.random.rand(30, 40, 3) * 255).astype(onp.uint8))
+    out = image.resize_short(img, 20)
+    assert min(out.shape[:2]) == 20
+    out, (x0, y0, w, h) = image.center_crop(img, (10, 12))
+    assert out.shape == (12, 10, 3)
+    out, _ = image.random_crop(img, (10, 10))
+    assert out.shape == (10, 10, 3)
+    out, _ = image.random_size_crop(img, (8, 8), (0.1, 1.0), (0.5, 2.0))
+    assert out.shape == (8, 8, 3)
+    assert image.scale_down((5, 5), (10, 10)) == (5, 5)
+
+
+def test_color_normalize_and_augmenters():
+    img = onp.full((4, 4, 3), 100.0, onp.float32)
+    out = image.color_normalize(mx.nd.array(img), mx.nd.array([100.0] * 3),
+                                mx.nd.array([2.0] * 3))
+    assert_almost_equal(out, onp.zeros((4, 4, 3)))
+    img_u8 = mx.nd.array((onp.random.rand(8, 8, 3) * 255).astype(onp.uint8))
+    for aug in image.CreateAugmenter((3, 8, 8), rand_crop=True,
+                                     rand_mirror=True, brightness=0.1,
+                                     contrast=0.1, saturation=0.1, hue=0.1,
+                                     pca_noise=0.1, rand_gray=0.5, mean=True,
+                                     std=True):
+        img_u8 = aug(img_u8)
+    assert img_u8.shape == (8, 8, 3)
+    assert str(img_u8.dtype) == 'float32'
+
+
+def test_image_iter_rec(rec_dataset):
+    rec, idx = rec_dataset
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec, path_imgidx=idx, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    assert batches[0].label[0].shape == (4,)
+    assert batches[-1].pad == 2  # 10 = 4+4+2
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 32, 32)
+
+
+def test_image_iter_imglist(tmp_path):
+    from PIL import Image
+    fnames = []
+    for i in range(4):
+        arr = (onp.random.rand(20, 20, 3) * 255).astype(onp.uint8)
+        f = str(tmp_path / f'im{i}.png')
+        Image.fromarray(arr).save(f)
+        fnames.append((float(i), f'im{i}.png'))
+    it = image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                         path_root=str(tmp_path), imglist=fnames)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 16, 16)
+    assert b.label[0].asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_det_iter(tmp_path):
+    rec = str(tmp_path / 'det.rec')
+    idx = str(tmp_path / 'det.idx')
+    w = recordio.MXIndexedRecordIO(idx, rec, 'w')
+    rng = onp.random.RandomState(1)
+    for i in range(8):
+        img = (rng.rand(60, 60, 3) * 255).astype(onp.uint8)
+        label = onp.array([2, 5, 1.0, 0.1, 0.1, 0.6, 0.6,
+                           2.0, 0.3, 0.3, 0.9, 0.9], onp.float32)
+        w.write_idx(i, recordio.pack_img((0, label, i, 0), img))
+    w.close()
+    det = image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                             path_imgrec=rec, path_imgidx=idx,
+                             rand_crop=0.5, rand_pad=0.5, rand_mirror=True)
+    b = next(det)
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4, 50, 5)
+    lab = b.label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert len(valid) >= 4  # crops may eject some boxes, not all
+    assert (valid[:, 1:5] >= -1e-5).all() and (valid[:, 1:5] <= 1 + 1e-5).all()
+
+
+def test_det_flip_mirrors_boxes():
+    img = mx.nd.array((onp.random.rand(10, 10, 3) * 255).astype(onp.uint8))
+    label = onp.array([[1.0, 0.1, 0.2, 0.4, 0.6]], onp.float32)
+    aug = image.DetHorizontalFlipAug(p=1.1)  # always flip
+    _, out = aug(img, label)
+    assert_almost_equal(out, onp.array([[1.0, 0.6, 0.2, 0.9, 0.6]]),
+                        rtol=1e-5)
