@@ -74,18 +74,6 @@ class DetHorizontalFlipAug(DetAugmenter):
         return src, label
 
 
-def _box_iou(box, boxes):
-    ix = onp.maximum(0, onp.minimum(box[2], boxes[:, 3])
-                     - onp.maximum(box[0], boxes[:, 1]))
-    iy = onp.maximum(0, onp.minimum(box[3], boxes[:, 4])
-                     - onp.maximum(box[1], boxes[:, 2]))
-    inter = ix * iy
-    area_b = (box[2] - box[0]) * (box[3] - box[1])
-    area_o = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
-    union = area_b + area_o - inter
-    return onp.where(union > 0, inter / onp.maximum(union, 1e-12), 0.0)
-
-
 class DetRandomCropAug(DetAugmenter):
     """SSD-style random crop constrained by min IOU with objects
     (ref: DetRandomCropAug)."""
@@ -112,8 +100,17 @@ class DetRandomCropAug(DetAugmenter):
             y0 = pyrandom.uniform(0, 1 - ch)
             crop = onp.array([x0, y0, x0 + cw, y0 + ch])
             if label.shape[0]:
-                ious = _box_iou(crop, label)
-                if ious.max() < self.min_object_covered:
+                # acceptance gate: fraction of each object covered by the
+                # crop (reference min_object_covered semantics, not IOU)
+                ix = onp.maximum(0, onp.minimum(crop[2], label[:, 3])
+                                 - onp.maximum(crop[0], label[:, 1]))
+                iy = onp.maximum(0, onp.minimum(crop[3], label[:, 4])
+                                 - onp.maximum(crop[1], label[:, 2]))
+                obj_area = onp.maximum(
+                    (label[:, 3] - label[:, 1]) * (label[:, 4] - label[:, 2]),
+                    1e-12)
+                coverage = (ix * iy) / obj_area
+                if coverage.max() < self.min_object_covered:
                     continue
             new_label = self._update_labels(label, crop)
             if label.shape[0] and new_label.shape[0] == 0:
@@ -243,16 +240,18 @@ class ImageDetIter(ImageIter):
                  path_imglist=None, path_root='', path_imgidx=None,
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, object_width=5, max_objects=50,
-                 dtype='float32', **kwargs):
+                 dtype='float32', last_batch_handle='pad', **kwargs):
+        aug_keys = ('resize', 'rand_crop', 'rand_pad', 'rand_gray',
+                    'rand_mirror', 'mean', 'std', 'brightness', 'contrast',
+                    'saturation', 'pca_noise', 'hue', 'inter_method',
+                    'min_object_covered', 'aspect_ratio_range', 'area_range',
+                    'min_eject_coverage', 'max_attempts', 'pad_val')
+        unknown = set(kwargs) - set(aug_keys)
+        if unknown:
+            raise TypeError(
+                f"ImageDetIter got unknown kwargs: {sorted(unknown)}")
         if aug_list is None:
-            aug_list = CreateDetAugmenter(data_shape, **{
-                k: v for k, v in kwargs.items()
-                if k in ('resize', 'rand_crop', 'rand_pad', 'rand_gray',
-                         'rand_mirror', 'mean', 'std', 'brightness',
-                         'contrast', 'saturation', 'pca_noise', 'hue',
-                         'inter_method', 'min_object_covered',
-                         'aspect_ratio_range', 'area_range',
-                         'min_eject_coverage', 'max_attempts', 'pad_val')})
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
         self.object_width = object_width
         self.max_objects = max_objects
         super().__init__(batch_size, data_shape, label_width=1,
@@ -260,7 +259,8 @@ class ImageDetIter(ImageIter):
                          path_root=path_root, path_imgidx=path_imgidx,
                          shuffle=shuffle, part_index=part_index,
                          num_parts=num_parts, aug_list=aug_list,
-                         imglist=imglist, dtype=dtype)
+                         imglist=imglist, dtype=dtype,
+                         last_batch_handle=last_batch_handle)
         from ..io.io import DataDesc
         self.provide_label = [DataDesc(
             'label', (batch_size, max_objects, object_width), onp.float32)]
@@ -302,7 +302,7 @@ class ImageDetIter(ImageIter):
                 batch_label[i, :n] = objs[:n]
                 i += 1
         except StopIteration:
-            if i == 0:
+            if i == 0 or self.last_batch_handle == 'discard':
                 raise
         pad = self.batch_size - i
         return DataBatch(data=[_nd_array(batch_data)],

@@ -65,18 +65,27 @@ def imread(filename, flag=1, to_rgb=True, **kwargs):
 
 
 def imresize(src, w, h, interp=1):
-    """Resize to (w, h) (ref: image.py imresize)."""
-    from PIL import Image
+    """Resize to (w, h), preserving dtype (ref: image.py imresize)."""
     arr = _to_np(src)
-    squeeze = arr.shape[2] == 1
-    mode_arr = arr[:, :, 0] if squeeze else arr
-    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
-                3: Image.NEAREST, 4: Image.LANCZOS}.get(interp, Image.BILINEAR)
-    out = onp.asarray(Image.fromarray(mode_arr.astype(onp.uint8)).resize(
-        (w, h), resample))
-    if squeeze:
-        out = out[:, :, None]
-    return _nd_array(out)
+    if arr.dtype == onp.uint8:
+        from PIL import Image
+        squeeze = arr.shape[2] == 1
+        mode_arr = arr[:, :, 0] if squeeze else arr
+        resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                    3: Image.NEAREST, 4: Image.LANCZOS}.get(
+                        interp, Image.BILINEAR)
+        out = onp.asarray(Image.fromarray(mode_arr).resize((w, h), resample))
+        if squeeze:
+            out = out[:, :, None]
+        return _nd_array(out)
+    # float data: interpolate without quantizing (reference cv2.resize
+    # keeps dtype)
+    import jax.image
+    method = {0: 'nearest', 1: 'bilinear', 2: 'bicubic',
+              3: 'nearest', 4: 'lanczos5'}.get(interp, 'bilinear')
+    out = jax.image.resize(arr.astype(onp.float32),
+                           (h, w, arr.shape[2]), method=method)
+    return _nd_array(onp.asarray(out).astype(arr.dtype, copy=False))
 
 
 def scale_down(src_size, size):
@@ -472,6 +481,11 @@ class ImageIter:
                 self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, 'r')
                 self.seq = list(self.imgrec.keys)
             else:
+                if shuffle or num_parts > 1:
+                    raise ValueError(
+                        "shuffle/num_parts on a .rec file require a .idx "
+                        "index (pass path_imgidx); sequential readers "
+                        "cannot shuffle or shard")
                 self.imgrec = MXRecordIO(path_imgrec, 'r')
         elif path_imglist:
             imglist_d = {}
@@ -497,13 +511,14 @@ class ImageIter:
             n = len(self.seq) // num_parts
             self.seq = self.seq[part_index * n:(part_index + 1) * n]
 
+        aug_keys = ('resize', 'rand_crop', 'rand_resize', 'rand_mirror',
+                    'mean', 'std', 'brightness', 'contrast', 'saturation',
+                    'hue', 'pca_noise', 'rand_gray', 'inter_method')
+        unknown = set(kwargs) - set(aug_keys)
+        if unknown:
+            raise TypeError(f"ImageIter got unknown kwargs: {sorted(unknown)}")
         if aug_list is None:
-            aug_list = CreateAugmenter(data_shape, **{
-                k: v for k, v in kwargs.items()
-                if k in ('resize', 'rand_crop', 'rand_resize', 'rand_mirror',
-                         'mean', 'std', 'brightness', 'contrast',
-                         'saturation', 'hue', 'pca_noise', 'rand_gray',
-                         'inter_method')})
+            aug_list = CreateAugmenter(data_shape, **kwargs)
         self.auglist = aug_list
 
         label_shape = (batch_size,) if label_width == 1 \

@@ -17,6 +17,8 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence    # noqa: F401
 from . import attention   # noqa: F401
 from . import contrib     # noqa: F401
+from . import detection   # noqa: F401
+from . import misc        # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
 
@@ -31,4 +33,6 @@ from .optimizer_ops import *  # noqa: F401,F403
 from .sequence import *     # noqa: F401,F403
 from .attention import *    # noqa: F401,F403
 from .contrib import *      # noqa: F401,F403
+from .detection import *    # noqa: F401,F403
+from .misc import *         # noqa: F401,F403
 from .quantization import *  # noqa: F401,F403

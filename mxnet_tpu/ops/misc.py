@@ -1,0 +1,337 @@
+"""Misc contrib + legacy v1 ops.
+
+Ref: src/operator/contrib/{fft.cc,count_sketch.cc,krprod.cc,hawkes_ll.cc,
+quadratic_op.cc,gradient_multiplier_op.cc,stes_op.cc,nnz.cc,allclose_op.cc},
+src/operator/{l2_normalization.cc,instance_norm.cc,make_loss.cc,
+softmax_output.cc,slice_channel.cc}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def fft(data, compute_size=128):
+    """FFT of the last axis; real input → interleaved [re, im] output of
+    width 2*d (ref: src/operator/contrib/fft.cc layout)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(*data.shape[:-1], data.shape[-1] * 2)
+
+
+@_reg
+def ifft(data, compute_size=128):
+    """Inverse of `fft`: interleaved complex (…, 2d) → real (…, d)
+    (ref: src/operator/contrib/ifft.cc; like the reference, output is the
+    unnormalized IFFT — scale by 1/d to recover the original signal)."""
+    d = data.shape[-1] // 2
+    c = data.reshape(*data.shape[:-1], d, 2)
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(data.dtype) * d
+
+
+@_reg
+def count_sketch(data, h, s, out_dim):
+    """Count-sketch projection: out[:, h[i]] += s[i] * data[:, i]
+    (ref: src/operator/contrib/count_sketch.cc). Scatter-add lowers to one
+    XLA scatter instead of the reference's per-element CUDA kernel."""
+    n, in_dim = data.shape
+    hh = h.reshape(-1)[:in_dim].astype(jnp.int32)
+    ss = s.reshape(-1)[:in_dim].astype(data.dtype)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+@_reg
+def khatri_rao(*matrices):
+    """Column-wise Kronecker (Khatri-Rao) product
+    (ref: src/operator/contrib/krprod.cc)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        assert m.shape[1] == k, "khatri_rao: column counts must match"
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+@_reg
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c — the tutorial op
+    (ref: src/operator/contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@jax.custom_vjp
+def _grad_mult(data, scalar):
+    return data
+
+
+def _grad_mult_fwd(data, scalar):
+    return data, scalar
+
+
+def _grad_mult_bwd(scalar, ct):
+    return (ct * scalar, None)
+
+
+_grad_mult.defvjp(_grad_mult_fwd, _grad_mult_bwd)
+
+
+@_reg
+def gradient_multiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` (gradient reversal when
+    negative) (ref: src/operator/contrib/gradient_multiplier_op.cc)."""
+    return _grad_mult(data, jnp.asarray(scalar, data.dtype))
+
+
+@jax.custom_vjp
+def _round_ste_p(x):
+    return jnp.round(x)
+
+
+_round_ste_p.defvjp(lambda x: (jnp.round(x), None), lambda _, ct: (ct,))
+
+
+@jax.custom_vjp
+def _sign_ste_p(x):
+    return jnp.sign(x)
+
+
+_sign_ste_p.defvjp(lambda x: (jnp.sign(x), None), lambda _, ct: (ct,))
+
+
+@_reg
+def round_ste(data):
+    """Straight-through rounding (ref: src/operator/contrib/stes_op.cc)."""
+    return _round_ste_p(data)
+
+
+@_reg
+def sign_ste(data):
+    """Straight-through sign (ref: src/operator/contrib/stes_op.cc)."""
+    return _sign_ste_p(data)
+
+
+@_reg
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked self-exciting Hawkes process, one sample
+    per row (ref: src/operator/contrib/hawkes_ll.cc).
+
+    lda: (N, K) background intensity, alpha/beta: (K,), state: (N, K)
+    initial excitation, lags/marks: (N, T), valid_length: (N,),
+    max_time: (N,). Returns (ll (N,), new_state (N, K)).
+
+    The reference loops timesteps in a CUDA kernel; here the recurrence is
+    a lax.scan over T with everything batched — same O(N*T*K) work, fully
+    on-device.
+    """
+    N, T = lags.shape
+    K = lda.shape[1]
+    marks_i = marks.astype(jnp.int32)
+
+    def step(carry, t):
+        ll, rem, elapsed = carry
+        lag = lags[:, t]
+        mark = marks_i[:, t]
+        valid = (t < valid_length).astype(lda.dtype)
+
+        elapsed_new = elapsed + lag
+        decay = jnp.exp(-beta[None, :] * lag[:, None])
+        rem_decayed = rem * decay
+        intensity = lda + alpha[None, :] * rem_decayed
+        lam = jnp.take_along_axis(intensity, mark[:, None], axis=1)[:, 0]
+        ll_t = jnp.log(jnp.maximum(lam, 1e-20))
+
+        # compensator increment for the interval (integral of intensity)
+        comp = (lda * lag[:, None]
+                + (alpha / beta)[None, :] * rem * (1.0 - decay)).sum(1)
+        ll = ll + valid * (ll_t - comp)
+        rem_new = rem_decayed + jax.nn.one_hot(mark, K, dtype=lda.dtype)
+        rem = jnp.where(valid[:, None] > 0, rem_new, rem)
+        elapsed = jnp.where(valid > 0, elapsed_new, elapsed)
+        return (ll, rem, elapsed), None
+
+    init = (jnp.zeros((N,), lda.dtype), state, jnp.zeros((N,), lda.dtype))
+    (ll, rem, elapsed), _ = lax.scan(step, init, jnp.arange(T))
+
+    # tail compensator from last event to max_time
+    tail = jnp.maximum(max_time - elapsed, 0.0)
+    decay_tail = 1.0 - jnp.exp(-beta[None, :] * tail[:, None])
+    comp_tail = (lda * tail[:, None]
+                 + (alpha / beta)[None, :] * rem * decay_tail).sum(1)
+    ll = ll - comp_tail
+    new_state = rem * jnp.exp(-beta[None, :] * tail[:, None])
+    return ll, new_state
+
+
+@_reg
+def nnz(data, axis=None):
+    """Number of stored non-zeros (ref: src/operator/contrib/nnz.cc)."""
+    return jnp.count_nonzero(data, axis=axis).astype(jnp.int64)
+
+
+@_reg
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True):
+    """Scalar 0/1 allclose (ref: src/operator/contrib/allclose_op.cc)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@_reg
+def L2Normalization(data, eps=1e-10, mode='instance'):
+    """x / sqrt(sum(x^2) + eps) (ref: src/operator/l2_normalization.cc).
+
+    mode: 'instance' (over all but batch), 'channel' (over axis 1),
+    'spatial' (over trailing spatial axes)."""
+    if mode == 'instance':
+        axes = tuple(range(1, data.ndim))
+    elif mode == 'channel':
+        axes = (1,)
+    elif mode == 'spatial':
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(f"unknown L2Normalization mode {mode!r}")
+    norm = jnp.sqrt(jnp.sum(data * data, axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@_reg
+def l2_normalization(data, eps=1e-10, mode='instance'):
+    return L2Normalization(data, eps=eps, mode=mode)
+
+
+@_reg
+def InstanceNorm(data, gamma, beta, eps=1e-3):
+    """Per-sample, per-channel normalization over spatial axes
+    (ref: src/operator/instance_norm.cc)."""
+    axes = tuple(range(2, data.ndim))
+    mean = data.mean(axis=axes, keepdims=True)
+    var = data.var(axis=axes, keepdims=True)
+    xhat = (data - mean) / jnp.sqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return xhat * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@jax.custom_vjp
+def _make_loss_p(data, grad_scale):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale):
+    return data, grad_scale
+
+
+def _make_loss_bwd(grad_scale, ct):
+    # loss op: gradient is grad_scale regardless of the head gradient
+    # (ref: src/operator/make_loss.cc MakeLossGrad)
+    return (jnp.broadcast_to(grad_scale, ct.shape).astype(ct.dtype), None)
+
+
+_make_loss_p.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@_reg
+def MakeLoss(data, grad_scale=1.0, valid_thresh=0.0, normalization='null'):
+    """Mark an output as a loss: identity forward, constant grad_scale
+    backward (ref: src/operator/make_loss.cc)."""
+    scale = grad_scale
+    if normalization == 'batch':
+        scale = scale / data.shape[0]
+    elif normalization == 'valid':
+        scale = scale / jnp.maximum(
+            (data > valid_thresh).sum().astype(data.dtype), 1.0)
+    return _make_loss_p(data, jnp.asarray(scale, data.dtype))
+
+
+@_reg
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization='null'):
+    return MakeLoss(data, grad_scale, valid_thresh, normalization)
+
+
+@jax.custom_vjp
+def _softmax_output_p(data, label, grad_scale, ignore_label, use_ignore,
+                      multi_output):
+    return _softmax_fwd(data, multi_output)
+
+
+def _softmax_fwd(data, multi_output):
+    axis = 1 if multi_output and data.ndim > 2 else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output):
+    out = _softmax_fwd(data, multi_output)
+    return out, (out, label, grad_scale, ignore_label, use_ignore,
+                 multi_output)
+
+
+def _softmax_output_bwd(res, ct):
+    out, label, grad_scale, ignore_label, use_ignore, multi_output = res
+    # gradient = (softmax - onehot(label)) * scale, head grad ignored
+    # (ref: src/operator/softmax_output.cc SoftmaxOutputGrad)
+    axis = 1 if multi_output and out.ndim > 2 else -1
+    n_cls = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, n_cls, dtype=out.dtype)
+    if axis == 1 and out.ndim > 2:
+        onehot = jnp.moveaxis(onehot, -1, 1)
+    g = (out - onehot) * grad_scale
+    if use_ignore:
+        mask = (lab != ignore_label)
+        if axis == 1 and out.ndim > 2:
+            mask = mask[:, None]
+        else:
+            mask = mask[..., None]
+        g = g * mask.astype(out.dtype)
+    return (g, None, None, None, None, None)
+
+
+_softmax_output_p.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@_reg
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, multi_output=False,
+                  normalization='null', **kwargs):
+    """Legacy softmax + cross-entropy-gradient output op
+    (ref: src/operator/softmax_output.cc)."""
+    scale = grad_scale
+    if normalization == 'batch':
+        scale = scale / data.shape[0]
+    return _softmax_output_p(data, label, jnp.asarray(scale, data.dtype),
+                             ignore_label, bool(use_ignore),
+                             bool(multi_output))
+
+
+@_reg
+def softmax_output(data, label, **kwargs):
+    return SoftmaxOutput(data, label, **kwargs)
+
+
+@_reg
+def SliceChannel(data, num_outputs, axis=1, squeeze_axis=False):
+    """Split along an axis into num_outputs parts
+    (ref: src/operator/slice_channel.cc)."""
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [p.squeeze(axis) for p in parts]
+    return tuple(parts)
+
+
+@_reg
+def slice_channel(data, num_outputs, axis=1, squeeze_axis=False):
+    return SliceChannel(data, num_outputs, axis=axis,
+                        squeeze_axis=squeeze_axis)

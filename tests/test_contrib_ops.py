@@ -1,0 +1,259 @@
+"""Contrib + legacy op tests (ref: tests/python/unittest/test_contrib_operator.py,
+test_operator.py legacy-op sections)."""
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_fft_ifft_roundtrip():
+    x = onp.random.rand(2, 8).astype(onp.float32)
+    f = nd.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    ref = onp.fft.fft(x)
+    assert_almost_equal(f.asnumpy()[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(f.asnumpy()[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+    r = nd.ifft(f)  # unnormalized, like the reference
+    assert_almost_equal(r.asnumpy() / 8, x, rtol=1e-4, atol=1e-5)
+
+
+def test_count_sketch():
+    d = nd.array(onp.eye(3, dtype=onp.float32))
+    h = nd.array(onp.array([0, 1, 0]))
+    s = nd.array(onp.array([1.0, -1.0, 1.0]))
+    out = nd.count_sketch(d, h, s, 2)
+    assert out.asnumpy().tolist() == [[1, 0], [0, -1], [1, 0]]
+
+
+def test_khatri_rao():
+    a = onp.random.rand(2, 3).astype(onp.float32)
+    b = onp.random.rand(4, 3).astype(onp.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b))
+    ref = onp.stack([onp.kron(a[:, i], b[:, i]) for i in range(3)], 1)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_quadratic():
+    x = onp.random.rand(5).astype(onp.float32)
+    assert_almost_equal(nd.quadratic(nd.array(x), a=2.0, b=3.0, c=1.0),
+                        2 * x * x + 3 * x + 1, rtol=1e-5)
+
+
+def test_gradient_multiplier_reversal():
+    x = nd.array([1.0, -2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.gradient_multiplier(x, -0.5) * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([-1.0, -1.0]))
+
+
+def test_straight_through_estimators():
+    x = nd.array([0.3, 1.7, -0.2])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.round_ste(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.ones(3))
+    with autograd.record():
+        z = nd.sign_ste(x).sum()
+    z.backward()
+    assert_almost_equal(x.grad, onp.ones(3))
+
+
+def test_l2_normalization_modes():
+    d = onp.random.rand(2, 3, 4).astype(onp.float32) + 0.1
+    inst = nd.L2Normalization(nd.array(d), mode='instance').asnumpy()
+    assert_almost_equal((inst.reshape(2, -1) ** 2).sum(1), onp.ones(2),
+                        rtol=1e-4)
+    chan = nd.L2Normalization(nd.array(d), mode='channel').asnumpy()
+    assert_almost_equal((chan ** 2).sum(1), onp.ones((2, 4)), rtol=1e-4)
+
+
+def test_instance_norm():
+    d = onp.random.rand(2, 3, 8, 8).astype(onp.float32)
+    g = onp.random.rand(3).astype(onp.float32)
+    b = onp.random.rand(3).astype(onp.float32)
+    out = nd.InstanceNorm(nd.array(d), nd.array(g), nd.array(b),
+                          eps=1e-5).asnumpy()
+    mean = d.mean(axis=(2, 3), keepdims=True)
+    std = d.std(axis=(2, 3), keepdims=True)
+    ref = (d - mean) / onp.sqrt(std ** 2 + 1e-5) * g[None, :, None, None] \
+        + b[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_make_loss_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.MakeLoss(x, grad_scale=3.0)
+    loss.backward()
+    assert_almost_equal(x.grad, onp.array([3.0, 3.0]))
+
+
+def test_softmax_output_grad():
+    data = nd.array(onp.random.randn(4, 3).astype(onp.float32))
+    data.attach_grad()
+    label = nd.array(onp.array([0, 1, 2, 1], onp.float32))
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    oh = onp.eye(3)[[0, 1, 2, 1]]
+    assert_almost_equal(data.grad, p - oh, rtol=1e-4, atol=1e-5)
+    # use_ignore masks ignored rows
+    data.grad[:] = 0 if hasattr(data.grad, '__setitem__') else None
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label, use_ignore=True, ignore_label=1)
+    out.backward()
+    g = data.grad.asnumpy()
+    assert onp.allclose(g[1], 0) and onp.allclose(g[3], 0)
+    assert not onp.allclose(g[0], 0)
+
+
+def test_slice_channel():
+    x = onp.arange(12).reshape(2, 6).astype(onp.float32)
+    parts = nd.SliceChannel(nd.array(x), 3)
+    assert len(parts) == 3
+    assert_almost_equal(parts[1], x[:, 2:4])
+    sq = nd.SliceChannel(nd.array(x.reshape(2, 6, 1)), 1, axis=2,
+                         squeeze_axis=True)
+    assert sq[0].shape == (2, 6)
+
+
+def test_nnz_allclose():
+    x = nd.array([[0.0, 1.0], [2.0, 0.0]])
+    assert int(nd.nnz(x).asnumpy()) == 2
+    assert float(nd.allclose(x, x).asnumpy()) == 1.0
+    assert float(nd.allclose(x, x + 1).asnumpy()) == 0.0
+
+
+def test_hawkes_ll_matches_bruteforce():
+    """Single-type process checked against a direct numpy computation."""
+    lda = 0.5
+    alpha, beta = 0.3, 1.5
+    lags = onp.array([0.4, 0.7, 0.2], onp.float32)
+    times = onp.cumsum(lags)
+    T = 3.0
+    # direct: sum log intensity at events - integral of intensity
+    ll_ref = 0.0
+    for i, t in enumerate(times):
+        lam = lda + alpha * sum(onp.exp(-beta * (t - s))
+                                for s in times[:i])
+        ll_ref += onp.log(lam)
+    integral = lda * T + (alpha / beta) * sum(
+        1 - onp.exp(-beta * (T - s)) for s in times)
+    ll_ref -= integral
+    ll, _ = nd.hawkes_ll(
+        nd.array(onp.full((1, 1), lda, onp.float32)),
+        nd.array(onp.array([alpha], onp.float32)),
+        nd.array(onp.array([beta], onp.float32)),
+        nd.array(onp.zeros((1, 1), onp.float32)),
+        nd.array(lags[None]),
+        nd.array(onp.zeros((1, 3), onp.float32)),
+        nd.array(onp.array([3.0], onp.float32)),
+        nd.array(onp.array([T], onp.float32)))
+    assert_almost_equal(ll.asnumpy()[0], ll_ref, rtol=1e-4)
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = onp.array([[[0.1, 0.1, 0.5, 0.5], [0.3, 0.3, 0.9, 0.9]]],
+                        onp.float32)
+    gt = onp.array([[[0.15, 0.12, 0.52, 0.48]]], onp.float32)
+    samples = onp.array([[1.0, 0.0]], onp.float32)
+    matches = onp.array([[0, 0]], onp.float32)
+    targets, masks = nd.box_encode(nd.array(samples), nd.array(matches),
+                                   nd.array(anchors), nd.array(gt))
+    dec = nd.box_decode(targets, nd.array(anchors))
+    assert_almost_equal(dec.asnumpy()[0, 0], gt[0, 0], rtol=1e-4, atol=1e-5)
+    assert masks.asnumpy()[0, 1].sum() == 0  # negative anchor masked
+
+
+def test_multibox_target_matching():
+    anchor = onp.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                         [0.0, 0.0, 0.2, 0.2]]], onp.float32)
+    label = onp.array([[[1.0, 0.12, 0.12, 0.38, 0.38],
+                        [-1, -1, -1, -1, -1]]], onp.float32)
+    cls_pred = onp.random.rand(1, 3, 3).astype(onp.float32)
+    bt, bm, ct = nd.multibox_target(nd.array(anchor), nd.array(label),
+                                    nd.array(cls_pred))
+    c = ct.asnumpy()[0]
+    assert c[0] == 2.0   # matched → class_id + 1
+    assert c[1] == 0.0 and c[2] == 0.0  # background
+    assert bm.asnumpy()[0, :4].sum() == 4.0  # positive anchor regressed
+    assert bm.asnumpy()[0, 4:].sum() == 0.0
+
+
+def test_multibox_detection_nms():
+    anchor = onp.array([[[0.1, 0.1, 0.4, 0.4], [0.11, 0.11, 0.41, 0.41],
+                         [0.6, 0.6, 0.9, 0.9]]], onp.float32)
+    cls_prob = onp.zeros((1, 2, 3), onp.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]   # one fg class
+    cls_prob[0, 0] = 0.1
+    loc = onp.zeros((1, 12), onp.float32)
+    det = nd.multibox_detection(nd.array(cls_prob), nd.array(loc),
+                                nd.array(anchor), nms_threshold=0.5)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] >= 0]
+    assert len(kept) == 2  # overlapping anchor suppressed
+
+
+def test_proposal_shapes_and_validity():
+    rng = onp.random.RandomState(0)
+    cls = rng.rand(2, 6, 4, 4).astype(onp.float32)
+    bb = (rng.randn(2, 12, 4, 4) * 0.1).astype(onp.float32)
+    info = onp.array([[64.0, 64.0, 1.0]] * 2, onp.float32)
+    rois = nd.proposal(nd.array(cls), nd.array(bb), nd.array(info),
+                       rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                       scales=(8,), ratios=(0.5, 1, 2), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (2, 5, 5)
+    assert (r[0, :, 0] == 0).all() and (r[1, :, 0] == 1).all()
+    assert (r[..., 1:] >= 0).all() and (r[..., 1:] <= 64).all()
+
+
+def test_psroi_pooling_constant_map():
+    # constant per position-channel → output equals that constant
+    G, D = 2, 3
+    data = onp.zeros((1, D * G * G, 8, 8), onp.float32)
+    for c in range(D * G * G):
+        data[0, c] = c
+    rois = onp.array([[0, 0, 0, 15.9, 15.9]], onp.float32)
+    out = nd.psroi_pooling(nd.array(data), nd.array(rois),
+                           spatial_scale=0.5, output_dim=D, pooled_size=G)
+    o = out.asnumpy()[0]
+    for d in range(D):
+        for gy in range(G):
+            for gx in range(G):
+                assert o[d, gy, gx] == d * G * G + gy * G + gx
+
+
+def test_deformable_conv_zero_offset_is_conv():
+    rng = onp.random.RandomState(0)
+    img = rng.rand(2, 4, 6, 6).astype(onp.float32)
+    off = onp.zeros((2, 18, 6, 6), onp.float32)
+    wt = rng.rand(8, 4, 3, 3).astype(onp.float32)
+    out = nd.deformable_convolution(nd.array(img), nd.array(off),
+                                    nd.array(wt), num_filter=8)
+    ref = jax.lax.conv_general_dilated(img, wt, (1, 1), [(1, 1), (1, 1)])
+    assert_almost_equal(out, onp.asarray(ref), rtol=1e-3, atol=1e-4)
+    off2 = onp.full_like(off, 0.5)
+    out2 = nd.deformable_convolution(nd.array(img), nd.array(off2),
+                                     nd.array(wt), num_filter=8)
+    assert not onp.allclose(out.asnumpy(), out2.asnumpy())
+
+
+def test_correlation_self_is_l2norm():
+    rng = onp.random.RandomState(0)
+    d1 = rng.rand(1, 2, 6, 6).astype(onp.float32)
+    corr = nd.correlation(nd.array(d1), nd.array(d1), max_displacement=1,
+                          pad_size=1)
+    assert corr.shape == (1, 9, 6, 6)
+    # center displacement channel (4) == mean over channels of x*x
+    center = corr.asnumpy()[0, 4]
+    ref = (d1[0] ** 2).mean(0)
+    assert_almost_equal(center, ref, rtol=1e-4)
