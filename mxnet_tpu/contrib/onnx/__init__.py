@@ -1,0 +1,6 @@
+"""ONNX interop (ref: python/mxnet/contrib/onnx/).
+
+Works without the `onnx` package: the protobuf wire format is emitted and
+parsed directly (see _proto.py)."""
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import import_model, import_to_gluon  # noqa: F401

@@ -269,6 +269,10 @@ class HybridBlock(Block):
         pass
 
     def __call__(self, *args):
+        from .. import symbol as sym_mod
+        if args and isinstance(args[0], sym_mod.Symbol):
+            # symbolic trace (export path) bypasses the compiled cache
+            return self.forward(*args)
         if self._active:
             try:
                 out = self._call_cached_op(*args)
@@ -316,7 +320,14 @@ class HybridBlock(Block):
         return new
 
     def forward(self, x, *args):
-        """Dispatch to hybrid_forward with params (ref: block.py:1156)."""
+        """Dispatch to hybrid_forward with params (ref: block.py:1156).
+        Symbol inputs trace the block into a Symbol DAG (params become
+        named variables) — the export / mx2onnx path."""
+        from .. import symbol as sym_mod
+        if isinstance(x, sym_mod.Symbol):
+            params = {i: sym_mod.var(j.name)
+                      for i, j in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **params)
         ctx = x.context if isinstance(x, NDArray) else current_context()
         try:
             params = {i: j.data(ctx) for i, j in self._reg_params.items()}
@@ -333,13 +344,32 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Save params for deployment (ref: block.py:1106). The symbolic
-        json graph is replaced by the block class + params: use
-        SymbolBlock/imports to reload."""
+    def export(self, path, epoch=0, remove_amp_cast=True,
+               input_names=('data',)):
+        """Export to `path-symbol.json` + `path-####.params`
+        (ref: block.py:1106): the block is traced into a Symbol DAG and
+        parameters are saved in the arg:/aux: keyed NDArray format, so the
+        pair reloads via SymbolBlock.imports — same deployment contract as
+        the reference."""
+        from .. import symbol as sym_mod
+        from ..ndarray import save as nd_save
+        inputs = [sym_mod.var(n) for n in input_names]
+        out = self(*inputs)
+        if isinstance(out, (list, tuple)):
+            raise MXNetError(
+                "export supports single-output blocks; group outputs first")
+        sym_file = f"{path}-symbol.json"
+        out.save(sym_file)
+        arg_names = set(out.list_arguments()) - set(input_names)
+        payload = {}
+        for name, p in self.collect_params().items():
+            if name not in arg_names:
+                continue
+            key = ('aux:' if p.grad_req == 'null' else 'arg:') + name
+            payload[key] = p.data()
         fname = f"{path}-{epoch:04d}.params"
-        self.save_parameters(fname)
-        return fname
+        nd_save(fname, payload)
+        return sym_file, fname
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         self.hybridize(True)
@@ -498,8 +528,23 @@ class SymbolBlock(HybridBlock):
         inputs = [sym_mod.var(n) for n in input_names]
         ret = SymbolBlock(s, inputs)
         if param_file is not None:
-            ret.load_parameters(param_file, ctx=ctx, cast_dtype=True)
+            from ..ndarray import load as nd_load
+            ret._load_arg_dict(nd_load(param_file), ctx=ctx)
         return ret
+
+    def _load_arg_dict(self, loaded, ctx=None):
+        """Load {\"arg:name\"/\"aux:name\"/name: NDArray} into this block's
+        symbol parameters (shared by imports and the ONNX importer)."""
+        input_names = {i.name for i in self._sym_inputs}
+        arg_names = set(self._sym_outputs.list_arguments()) - input_names
+        for key, arr in loaded.items():
+            name = key.split(':', 1)[1] if ':' in key else key
+            if name not in arg_names:
+                continue
+            p = self.params.get(name)
+            p.shape = tuple(arr.shape)
+            p.initialize(init='zeros', ctx=ctx)
+            p.set_data(arr)
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix='', params=params)

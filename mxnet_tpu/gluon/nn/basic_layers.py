@@ -171,9 +171,11 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         out, new_mean, new_var = F.batch_norm(
             x, gamma, beta, running_mean, running_var, **self._kwargs)
-        # write back running statistics (mutation threaded out under trace)
-        running_mean._data = new_mean._data
-        running_var._data = new_var._data
+        if isinstance(new_mean, NDArray):
+            # write back running statistics (mutation threaded out under
+            # trace; symbolic trace exports the inference graph, no update)
+            running_mean._data = new_mean._data
+            running_var._data = new_var._data
         return out
 
     def __repr__(self):
@@ -204,8 +206,9 @@ class SyncBatchNorm(BatchNorm):
         else:
             out, new_mean, new_var = F.batch_norm(
                 x, gamma, beta, running_mean, running_var, **kwargs)
-        running_mean._data = new_mean._data
-        running_var._data = new_var._data
+        if isinstance(new_mean, NDArray):
+            running_mean._data = new_mean._data
+            running_var._data = new_var._data
         return out
 
 

@@ -100,8 +100,8 @@ class DetRandomCropAug(DetAugmenter):
             y0 = pyrandom.uniform(0, 1 - ch)
             crop = onp.array([x0, y0, x0 + cw, y0 + ch])
             if label.shape[0]:
-                # acceptance gate: fraction of each object covered by the
-                # crop (reference min_object_covered semantics, not IOU)
+                # acceptance gate: every object the crop intersects must be
+                # covered at least min_object_covered (reference semantics)
                 ix = onp.maximum(0, onp.minimum(crop[2], label[:, 3])
                                  - onp.maximum(crop[0], label[:, 1]))
                 iy = onp.maximum(0, onp.minimum(crop[3], label[:, 4])
@@ -110,7 +110,10 @@ class DetRandomCropAug(DetAugmenter):
                     (label[:, 3] - label[:, 1]) * (label[:, 4] - label[:, 2]),
                     1e-12)
                 coverage = (ix * iy) / obj_area
-                if coverage.max() < self.min_object_covered:
+                touched = coverage > 0
+                if not touched.any():
+                    continue
+                if coverage[touched].min() < self.min_object_covered:
                     continue
             new_label = self._update_labels(label, crop)
             if label.shape[0] and new_label.shape[0] == 0:

@@ -36,3 +36,25 @@ from .contrib import *      # noqa: F401,F403
 from .detection import *    # noqa: F401,F403
 from .misc import *         # noqa: F401,F403
 from .quantization import *  # noqa: F401,F403
+
+# Multi-output arity annotations for the Symbol frontend: the eager path
+# returns real tuples, but Symbol needs static arity to build output views
+# (-1 = attr-dependent, resolved in symbol._op_arity).
+from ..base import _OP_REGISTRY, register_op as _rr
+
+
+def _set_arity(name, n):
+    od = _OP_REGISTRY.get(name)
+    if od is not None:
+        _rr(name, num_outputs=n, mutate_inputs=od.mutate_inputs,
+            nograd=od.nograd)(od.fn)
+
+
+for _name, _n in [
+    ('batch_norm', 3), ('sync_batch_norm_op', 3), ('moments', 2),
+    ('slogdet', 2), ('histogram', 2), ('hawkes_ll', 2),
+    ('multibox_target', 3), ('box_encode', 2),
+    ('sgd_mom_update', 2), ('adam_update', 3), ('rnn', -1),
+    ('SliceChannel', -1), ('slice_channel', -1),
+]:
+    _set_arity(_name, _n)

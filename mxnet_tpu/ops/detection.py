@@ -38,16 +38,7 @@ def _corner(box):
                            axis=-1)
 
 
-def _pair_iou(a, b):
-    """a: (A,4), b: (M,4) corners → (A, M)"""
-    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
-    br = jnp.minimum(a[:, None, 2:4], b[None, :, 2:4])
-    wh = jnp.maximum(br - tl, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
-    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
-    union = area_a[:, None] + area_b[None, :] - inter
-    return jnp.where(union > 0, inter / union, 0.0)
+from .contrib import _iou_corner as _pair_iou  # (A,4),(M,4) -> (A,M)
 
 
 @_reg

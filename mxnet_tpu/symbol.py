@@ -36,6 +36,10 @@ class Symbol:
         self._name = name
         self.num_outputs = num_outputs
         self.out_index = out_index
+        # node identity shared by indexed output views (set by __getitem__);
+        # variables share by name so rebuilt graphs bind consistently
+        Symbol._counter[0] += 1
+        self._uid = name if op is None else Symbol._counter[0]
 
     # ---- introspection ----------------------------------------------------
     @property
@@ -77,8 +81,12 @@ class Symbol:
                 if idx != 0:
                     raise MXNetError("index out of range")
                 return self
-            return Symbol(self.op, self.inputs, self.attrs, self._name,
+            if not 0 <= idx < self.num_outputs:
+                raise MXNetError("index out of range")
+            view = Symbol(self.op, self.inputs, self.attrs, self._name,
                           self.num_outputs, idx)
+            view._uid = self._uid  # same node, different output slot
+            return view
         raise MXNetError("Symbol only supports integer indexing")
 
     # ---- graph building ---------------------------------------------------
@@ -173,21 +181,27 @@ class Symbol:
     # ---- serialization ----------------------------------------------------
     def tojson(self):
         nodes = []
-        index = {}
+        index = {}  # node uid -> node idx (indexed views share the uid)
+        names = {}  # serialized name -> uid (duplicate-name guard)
 
         def visit(s):
-            if id(s) in index:
-                return index[id(s)]
-            in_idx = [visit(i) for i in s.inputs]
+            if s._uid in index:
+                return index[s._uid], s.out_index
+            in_refs = [visit(i) for i in s.inputs]
+            if s._name in names and names[s._name] != s._uid:
+                raise MXNetError(
+                    f"duplicate node name '{s._name}' in graph; names must "
+                    "be unique to serialize")
+            names[s._name] = s._uid
             idx = len(nodes)
             nodes.append({'op': s.op or 'null', 'name': s._name,
                           'attrs': {k: str(v) for k, v in s.attrs.items()},
-                          'inputs': [[i, 0, 0] for i in in_idx]})
-            index[id(s)] = idx
-            return idx
+                          'inputs': [[i, oi, 0] for i, oi in in_refs]})
+            index[s._uid] = idx
+            return idx, s.out_index
 
-        visit(self)
-        return json.dumps({'nodes': nodes, 'heads': [[len(nodes) - 1, 0, 0]],
+        head_idx, head_oi = visit(self)
+        return json.dumps({'nodes': nodes, 'heads': [[head_idx, head_oi, 0]],
                            'mxnet_tpu_version': 2}, indent=2)
 
     def save(self, fname):
@@ -209,8 +223,10 @@ class _SymbolList(list):
 
 
 def _eval_node(s, bindings, cache):
-    key = (id(s), s.out_index)
-    base_key = id(s)
+    # cache by node uid: indexed output views of one multi-output node
+    # share the uid, so the op runs once; distinct nodes never collide
+    # even under duplicate user-assigned names
+    base_key = s._uid
     if base_key in cache:
         out = cache[base_key]
     elif s.op is None:
@@ -221,16 +237,37 @@ def _eval_node(s, bindings, cache):
     else:
         in_vals = [_eval_node(i, bindings, cache) for i in s.inputs]
         opdef = get_op(s.op)
-        out = opdef.fn(*in_vals, **s.attrs)
+        clean_attrs = {k: v for k, v in s.attrs.items()
+                       if not k.startswith('__')}
+        out = opdef.fn(*in_vals, **clean_attrs)
         cache[base_key] = out
     if isinstance(out, tuple):
         return out[s.out_index]
     return out
 
 
+def _op_arity(opname, attrs):
+    """Static output count of an op node (multi-output ops declare it in
+    the registry; -1 means attr-dependent)."""
+    opdef = get_op(opname)
+    n = opdef.num_outputs
+    if n != -1:
+        return n
+    if opname in ('split', 'SliceChannel', 'slice_channel'):
+        return int(attrs.get('num_outputs', 1))
+    if opname == 'topk':
+        return 2 if attrs.get('ret_typ') == 'both' else 1
+    if opname == 'rnn':
+        return 3 if attrs.get('mode', 'lstm') == 'lstm' else 2
+    return 1
+
+
 def _apply(opname, inputs, attrs, name=None):
-    get_op(opname)  # validate
-    return Symbol(opname, inputs, attrs, name)
+    n = _op_arity(opname, attrs)
+    s = Symbol(opname, inputs, attrs, name, num_outputs=n)
+    if n == 1:
+        return s
+    return tuple(s[i] for i in range(n))
 
 
 def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
@@ -264,7 +301,11 @@ def fromjson(js):
     nodes = data['nodes']
     built = []
     for node in nodes:
-        inputs = [built[i[0]] for i in node['inputs']]
+        inputs = []
+        for ref in node['inputs']:
+            src = built[ref[0]]
+            oi = ref[1] if len(ref) > 1 else 0
+            inputs.append(src[oi] if src.num_outputs > 1 else src)
         attrs = {}
         for k, v in node.get('attrs', {}).items():
             try:
@@ -274,9 +315,13 @@ def fromjson(js):
         if node['op'] == 'null':
             built.append(var(node['name']))
         else:
-            built.append(Symbol(node['op'], inputs, attrs, node['name']))
-    head = data['heads'][0][0]
-    return built[head]
+            n = _op_arity(node['op'], attrs)
+            built.append(Symbol(node['op'], inputs, attrs, node['name'],
+                                num_outputs=n))
+    head = data['heads'][0]
+    s = built[head[0]]
+    oi = head[1] if len(head) > 1 else 0
+    return s[oi] if s.num_outputs > 1 else s
 
 
 class Executor:

@@ -1,0 +1,139 @@
+"""ONNX interop + HybridBlock.export tests (ref:
+tests/python-pytest/onnx/ in the reference)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib import onnx as onnx_mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _cnn():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation='relu'),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(16, activation='tanh'),
+            gluon.nn.Dropout(0.5),
+            gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_hybrid_export_symbolblock_roundtrip(tmp_path):
+    net = _cnn()
+    x = nd.array(onp.random.rand(2, 1, 8, 8).astype(onp.float32))
+    ref = net(x).asnumpy()
+    sym_f, par_f = net.export(str(tmp_path / 'm'))
+    assert os.path.exists(sym_f) and os.path.exists(par_f)
+    net2 = gluon.SymbolBlock.imports(sym_f, 'data', par_f)
+    assert_almost_equal(net2(x), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_symbol_json_multi_output_roundtrip():
+    from mxnet_tpu import symbol as sym
+    x = sym.var('x')
+    g = sym.var('gamma')
+    b = sym.var('beta')
+    mean = sym.var('mean')
+    var_ = sym.var('var')
+    out = sym.batch_norm(x, g, b, mean, var_, use_global_stats=True)
+    head = out[0] + 1.0 if isinstance(out, tuple) else out + 1.0
+    js = head.tojson()
+    back = sym.fromjson(js)
+    d = onp.random.rand(2, 3).astype(onp.float32)
+    bindings = dict(x=nd.array(d), gamma=nd.array(onp.ones(3, onp.float32)),
+                    beta=nd.array(onp.zeros(3, onp.float32)),
+                    mean=nd.array(onp.zeros(3, onp.float32)),
+                    var=nd.array(onp.ones(3, onp.float32)))
+    ref = head.eval_dict(bindings).asnumpy()
+    got = back.eval_dict(bindings).asnumpy()
+    assert_almost_equal(got, ref, rtol=1e-6)
+
+
+def test_onnx_cnn_roundtrip(tmp_path):
+    net = _cnn()
+    x = nd.array(onp.random.rand(2, 1, 8, 8).astype(onp.float32))
+    ref = net(x).asnumpy()
+    p = str(tmp_path / 'model.onnx')
+    onnx_mx.export_model(net, None, input_shapes=[(2, 1, 8, 8)],
+                         onnx_file_path=p)
+    assert os.path.getsize(p) > 1000
+    sym, arg_params, aux = onnx_mx.import_model(p)
+    assert len(arg_params) > 0
+    net2 = onnx_mx.import_to_gluon(p)
+    assert_almost_equal(net2(x), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_lm_roundtrip(tmp_path):
+    class TinyLM(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = gluon.nn.Embedding(50, 16)
+            self.ln = gluon.nn.LayerNorm()
+            self.fc1 = gluon.nn.Dense(32, flatten=False)
+            self.fc2 = gluon.nn.Dense(50, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.ln(self.emb(x)) * 2.0 + 0.5
+            h = F.activation(self.fc1(h), act_type='relu')
+            return F.softmax(self.fc2(h), axis=-1)
+
+    net = TinyLM()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.randint(0, 50, (2, 7)).astype(onp.float32))
+    ref = net(x).asnumpy()
+    p = str(tmp_path / 'lm.onnx')
+    onnx_mx.export_model(net, None, input_shapes=[(2, 7)], onnx_file_path=p)
+    net2 = onnx_mx.import_to_gluon(p)
+    assert_almost_equal(net2(x), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_symbol_export(tmp_path):
+    """Export a raw Symbol graph with explicit params."""
+    from mxnet_tpu import symbol as sym
+    x = sym.var('data')
+    w = sym.var('w')
+    out = sym.relu(sym.dot(x, w) * 0.5)
+    w_val = onp.random.rand(3, 4).astype(onp.float32)
+    p = str(tmp_path / 's.onnx')
+    onnx_mx.export_model(out, {'w': nd.array(w_val)},
+                         input_shapes=[(2, 3)], onnx_file_path=p)
+    sym2, args, _ = onnx_mx.import_model(p)
+    x_val = onp.random.rand(2, 3).astype(onp.float32)
+    got = sym2.eval_dict({'data': nd.array(x_val), **args}).asnumpy()
+    ref = onp.maximum((x_val @ w_val) * 0.5, 0)
+    assert_almost_equal(got, ref, rtol=1e-5)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    from mxnet_tpu import symbol as sym
+    x = sym.var('data')
+    out = sym.topk(x, k=2)  # no ONNX translation registered
+    with pytest.raises(ValueError, match="no translation"):
+        onnx_mx.export_model(out, {}, input_shapes=[(2, 3)],
+                             onnx_file_path=str(tmp_path / 'x.onnx'))
+
+
+def test_protobuf_layer_varints():
+    from mxnet_tpu.contrib.onnx import _proto as P
+    for v in (0, 1, 127, 128, 300, 2 ** 32, -1, -42):
+        enc = P.write_varint(v)
+        dec, pos = P.read_varint(enc, 0)
+        assert P.to_signed(dec) == v, v
+        assert pos == len(enc)
+
+
+def test_tensor_proto_roundtrip():
+    from mxnet_tpu.contrib.onnx import onnx_repr as O
+    for arr in (onp.random.rand(3, 4).astype(onp.float32),
+                onp.arange(6, dtype=onp.int64).reshape(2, 3),
+                onp.array(2.5, onp.float32)):
+        name, back = O.parse_tensor(O.tensor('t', arr))
+        assert name == 't'
+        assert back.dtype == arr.dtype
+        assert_almost_equal(back, arr)
