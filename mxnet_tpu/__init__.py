@@ -48,6 +48,7 @@ from . import parallel
 from . import amp
 from . import contrib
 from . import operator
+from . import torch
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',

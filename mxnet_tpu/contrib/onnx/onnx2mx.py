@@ -67,6 +67,17 @@ class _Importer:
             self.env[outputs[0]] = out
 
     # ---- ops ---------------------------------------------------------------
+    @staticmethod
+    def _sym_pads(op_name, pads, nd):
+        """ONNX pads = [begin..., end...]; the framework conv/pool take
+        symmetric pads only — reject silent truncation."""
+        begin, end = pads[:nd], pads[nd:2 * nd]
+        if begin != end:
+            raise ValueError(
+                f"ONNX import: {op_name} with asymmetric pads {pads} is "
+                "unsupported (begin != end); pad the input explicitly")
+        return tuple(begin)
+
     def _op_Conv(self, n):
         a = n['attrs']
         ins = [self._get(x) for x in n['inputs']]
@@ -78,7 +89,8 @@ class _Importer:
             *ins, kernel=tuple(kernel),
             stride=tuple(_ints(a.get('strides', [1] * len(kernel)))),
             dilate=tuple(_ints(a.get('dilations', [1] * len(kernel)))),
-            pad=tuple(pads[:len(kernel)]), num_filter=num_filter,
+            pad=self._sym_pads('Conv', pads, len(kernel)),
+            num_filter=num_filter,
             num_group=int(a.get('group', 1)),
             no_bias=len(ins) < 3)
 
@@ -87,6 +99,10 @@ class _Importer:
         ins = [self._get(x) for x in n['inputs']]
         if not a.get('transB', 0):
             raise ValueError("ONNX import: Gemm without transB unsupported")
+        if float(a.get('alpha', 1.0)) != 1.0 or \
+                float(a.get('beta', 1.0)) != 1.0:
+            raise ValueError(
+                "ONNX import: Gemm with alpha/beta != 1 is unsupported")
         w = self.inits.get(n['inputs'][1])
         nh = int(w.shape[0]) if w is not None else 0
         return self.sym_mod.fully_connected(
@@ -122,7 +138,7 @@ class _Importer:
         return self.sym_mod.pooling(
             x, kernel=tuple(kernel), pool_type=ptype,
             stride=tuple(_ints(a.get('strides', kernel))),
-            pad=tuple(pads[:len(kernel)]),
+            pad=self._sym_pads(f'{ptype}Pool', pads, len(kernel)),
             count_include_pad=bool(a.get('count_include_pad', 1)))
 
     def _op_MaxPool(self, n):

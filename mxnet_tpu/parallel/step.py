@@ -15,12 +15,45 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import state as _flags
 from ..ndarray.ndarray import NDArray
 from .. import random as _random
 from .mesh import default_mesh
+
+
+def _put_replicated(x, sharding):
+    """Place parameter/optimizer data with a (possibly multi-host) sharding.
+    Multi-process: broadcast process 0's value first, so every worker starts
+    from identical parameters regardless of local RNG state — the analog of
+    the reference's kvstore.init broadcast from worker 0
+    (ref: src/kvstore/kvstore_dist.h InitImpl)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        x = multihost_utils.broadcast_one_to_all(onp.asarray(x))
+        x = onp.asarray(x)
+    return jax.device_put(x, sharding)
+
+
+def _put_batch(x, sharding):
+    """Place a batch with the dp sharding. Single-process: the array is the
+    global batch. Multi-process: each process holds its OWN shard (the
+    reference's per-worker data partition, tools/launch.py semantics), and
+    the global batch is their concatenation over the dp axis."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            sharding, onp.asarray(x))
+    return jax.device_put(x, sharding)
+
+
+def _local_value(arr):
+    """A fully-addressable view of a replicated global array (loss outputs
+    span all processes; every device holds the same value)."""
+    if jax.process_count() > 1 and not arr.is_fully_addressable:
+        return arr.addressable_data(0)
+    return arr
 
 
 def _sgd_init(p):
@@ -235,24 +268,24 @@ class ShardedTrainStep:
             self._build(in_datas, lab_datas)
             # place params on the mesh with their shardings
             for n, p in self._trainable:
-                p._data[0]._data = jax.device_put(p.data()._data,
-                                                  self._t_shardings[n])
+                p._data[0]._data = _put_replicated(p.data()._data,
+                                                   self._t_shardings[n])
             for n, p in self._frozen:
-                p._data[0]._data = jax.device_put(p.data()._data,
-                                                  self._f_shardings[n])
-            self._opt_state = jax.device_put(
-                self._opt_state,
-                {n: tuple(NamedSharding(self.mesh, P()) if s.ndim == 0
-                          else self._t_shardings[n]
-                          for s in self._opt_state[n])
-                 for n in self._t_names})
+                p._data[0]._data = _put_replicated(p.data()._data,
+                                                   self._f_shardings[n])
+            self._opt_state = {
+                n: tuple(_put_replicated(
+                    s, NamedSharding(self.mesh, P()) if s.ndim == 0
+                    else self._t_shardings[n])
+                    for s in self._opt_state[n])
+                for n in self._t_names}
 
         t_params = {n: p.data()._data for n, p in self._trainable}
         f_params = {n: p.data()._data for n, p in self._frozen}
         key = _random.next_key()
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
-        in_datas = tuple(jax.device_put(x, self._batch_sh) for x in in_datas)
-        lab_datas = tuple(jax.device_put(x, self._batch_sh) for x in lab_datas)
+        in_datas = tuple(_put_batch(x, self._batch_sh) for x in in_datas)
+        lab_datas = tuple(_put_batch(x, self._batch_sh) for x in lab_datas)
         new_t, new_f, new_state, loss = self._compiled(
             t_params, f_params, self._opt_state, in_datas, lab_datas, key,
             lr_val)
@@ -262,4 +295,4 @@ class ShardedTrainStep:
             p.data()._data = new_f[n]
         self._opt_state = new_state
         self._step_count += 1
-        return NDArray(loss)
+        return NDArray(_local_value(loss))

@@ -25,17 +25,26 @@ class _KeyProvider:
 
 
 class _GlobalKeyProvider(_KeyProvider):
+    """Lazily materializes the base key: creating a PRNGKey initializes the
+    XLA backend, which must not happen at import time (it would break
+    jax.distributed.initialize in multi-process jobs)."""
+
     def __init__(self, seed_val: int = 0):
         self._lock = threading.Lock()
-        self.seed(seed_val)
+        self._seed_val = seed_val
+        self._base = None
+        self._counter = 0
 
     def seed(self, seed_val: int):
         with self._lock:
-            self._base = jax.random.PRNGKey(seed_val)
+            self._seed_val = seed_val
+            self._base = None
             self._counter = 0
 
     def next_key(self):
         with self._lock:
+            if self._base is None:
+                self._base = jax.random.PRNGKey(self._seed_val)
             self._counter += 1
             return jax.random.fold_in(self._base, self._counter)
 

@@ -1,0 +1,124 @@
+"""PyTorch interop bridge (ref: python/mxnet/torch.py, plugin/torch/).
+
+The reference's legacy bridge wrapped Torch7 C functions as operators.
+The modern equivalent: zero-copy tensor exchange over DLPack plus a
+TorchOp adapter that runs a torch.nn.Module/function as a framework op
+with gradients flowing through torch.autograd — useful for porting models
+piecewise.
+
+CPU tensors move zero-copy; accelerator tensors fall back to host copies
+(torch here is CPU-only).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ['to_torch', 'from_torch', 'TorchOp']
+
+
+def _torch():
+    import torch as _t
+    return _t
+
+
+def to_torch(arr):
+    """NDArray → torch.Tensor (zero-copy via DLPack when on CPU)."""
+    t = _torch()
+    if not isinstance(arr, NDArray):
+        raise TypeError("to_torch expects an NDArray")
+    try:
+        return t.from_dlpack(arr._data)
+    except Exception:
+        return t.from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor):
+    """torch.Tensor → NDArray (zero-copy via DLPack when possible)."""
+    import jax
+    t = _torch()
+    if not isinstance(tensor, t.Tensor):
+        raise TypeError("from_torch expects a torch.Tensor")
+    tensor = tensor.detach().contiguous()
+    try:
+        return NDArray(jax.dlpack.from_dlpack(tensor))
+    except Exception:
+        return nd_array(tensor.cpu().numpy())
+
+
+class TorchOp:
+    """Run a torch callable (function or nn.Module) as a framework op.
+
+    Forward converts inputs to torch tensors, runs the callable, and
+    returns NDArrays; when autograd is recording, backward replays through
+    torch.autograd — so a torch layer can sit inside a Gluon model while
+    porting (ref: plugin/torch module bridge intent).
+    """
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *inputs):
+        from . import _imperative
+        from .base import state
+        t = _torch()
+
+        recording = state.is_recording and \
+            any(isinstance(a, NDArray) and a._in_graph for a in inputs)
+
+        torch_in = []
+        for a in inputs:
+            ta = t.from_numpy(onp.asarray(
+                a.asnumpy() if isinstance(a, NDArray) else a))
+            ta.requires_grad_(recording and ta.is_floating_point())
+            torch_in.append(ta)
+
+        out = self.fn(*torch_in)
+        tuple_out = isinstance(out, (tuple, list))
+        outs = list(out) if tuple_out else [out]
+        nd_outs = [nd_array(o.detach().cpu().numpy()) for o in outs]
+
+        if recording:
+            nd_inputs = [a for a in inputs if isinstance(a, NDArray)]
+            grad_sources = [ta for a, ta in zip(inputs, torch_in)
+                            if isinstance(a, NDArray)]
+            # nn.Module weights: backward accumulates into their .grad
+            # (standard torch semantics) so a torch optimizer can step them
+            module_params = [p for p in self.fn.parameters()
+                             if p.requires_grad] \
+                if hasattr(self.fn, 'parameters') else []
+
+            def vjp_fn(ct_struct):
+                cts = ct_struct if isinstance(ct_struct, tuple) \
+                    else (ct_struct,)
+                torch_cts = [t.from_numpy(onp.asarray(c)) for c in cts]
+                diff_inputs = [g for g in grad_sources if g.requires_grad]
+                grads = t.autograd.grad(
+                    outs, diff_inputs + module_params,
+                    grad_outputs=torch_cts[:len(outs)],
+                    retain_graph=True, allow_unused=True)
+                in_grads = grads[:len(diff_inputs)]
+                for p, g in zip(module_params, grads[len(diff_inputs):]):
+                    if g is None:
+                        continue
+                    p.grad = g if p.grad is None else p.grad + g
+                grad_iter = iter(in_grads)
+                result = []
+                for g_src in grad_sources:
+                    if g_src.requires_grad:
+                        g = next(grad_iter)
+                        result.append(
+                            onp.zeros(g_src.shape, onp.float32) if g is None
+                            else g.cpu().numpy())
+                    else:
+                        result.append(onp.zeros(tuple(g_src.shape),
+                                                onp.float32))
+                import jax.numpy as jnp
+                return tuple(jnp.asarray(r) for r in result)
+
+            _imperative.record_node(nd_inputs, nd_outs, vjp_fn, fn=None,
+                                    name=f"TorchOp[{type(self.fn).__name__}]",
+                                    tuple_out=len(nd_outs) > 1)
+
+        return tuple(nd_outs) if tuple_out else nd_outs[0]

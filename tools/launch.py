@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py + dmlc-core tracker).
+
+The reference spawns scheduler + server + worker processes over ssh/mpi/
+yarn with DMLC_* env bootstrap; here every process is a symmetric SPMD
+worker (no parameter servers — collectives ride ICI/DCN via
+jax.distributed), so the launcher only has to start N copies of the
+training script with the coordinator env protocol understood by
+mxnet_tpu.parallel.dist.init().
+
+Usage:
+  python tools/launch.py -n 4 python train.py --epochs 1
+  python tools/launch.py -n 8 -H hostfile --launcher ssh python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(args, command):
+    env_extra = {}
+    if args.env:
+        for kv in args.env:
+            k, _, v = kv.partition('=')
+            env_extra[k] = v
+    procs = []
+    for i in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(env_extra)
+        env['MXNET_TPU_COORDINATOR'] = f"localhost:{args.port}"
+        env['MXNET_TPU_NUM_PROCS'] = str(args.num_workers)
+        env['MXNET_TPU_PROC_ID'] = str(i)
+        procs.append(subprocess.Popen(command, env=env))
+    codes = [p.wait() for p in procs]
+    return next((c if c > 0 else 1 for c in codes if c != 0), 0)
+
+
+def launch_ssh(args, command):
+    if not args.hostfile:
+        print("--launcher ssh requires -H hostfile", file=sys.stderr)
+        return 1
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and
+                 not h.startswith('#')]
+    if len(hosts) < args.num_workers:
+        print(f"hostfile has {len(hosts)} hosts < -n {args.num_workers}",
+              file=sys.stderr)
+        return 1
+    coordinator = f"{hosts[0]}:{args.port}"
+    procs = []
+    for i in range(args.num_workers):
+        envs = (f"MXNET_TPU_COORDINATOR={coordinator} "
+                f"MXNET_TPU_NUM_PROCS={args.num_workers} "
+                f"MXNET_TPU_PROC_ID={i}")
+        for kv in args.env or []:
+            envs += f" {kv}"
+        remote_cmd = f"cd {os.getcwd()} && {envs} " + \
+            ' '.join(command)
+        procs.append(subprocess.Popen(['ssh', '-o',
+                                       'StrictHostKeyChecking=no',
+                                       hosts[i], remote_cmd]))
+    codes = [p.wait() for p in procs]
+    return next((c if c > 0 else 1 for c in codes if c != 0), 0)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='Launch a distributed mxnet_tpu job '
+                    '(ref: tools/launch.py)')
+    parser.add_argument('-n', '--num-workers', type=int, required=True,
+                        help='number of worker processes')
+    parser.add_argument('--launcher', choices=['local', 'ssh'],
+                        default='local')
+    parser.add_argument('-H', '--hostfile', default=None,
+                        help='hostfile for ssh launcher (one host per line)')
+    parser.add_argument('-p', '--port', type=int, default=29500,
+                        help='coordinator port on worker 0')
+    parser.add_argument('--env', action='append', default=[],
+                        help='extra KEY=VALUE env for workers (repeatable)')
+    # legacy compatibility: accepted and ignored (no parameter servers)
+    parser.add_argument('-s', '--num-servers', type=int, default=0,
+                        help='ignored: the TPU backend has no server '
+                             'processes (sync allreduce only)')
+    args, command = parser.parse_known_args()
+    if not command:
+        parser.error('no command given')
+    if command[0] == '--':
+        command = command[1:]
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — collectives replace "
+              "parameter servers", file=sys.stderr)
+    if args.launcher == 'local':
+        sys.exit(launch_local(args, command))
+    sys.exit(launch_ssh(args, command))
+
+
+if __name__ == '__main__':
+    main()
