@@ -46,11 +46,13 @@ def main():
     y = nd.array(rng.randint(0, 1000, batch).astype(onp.float32))
 
     for _ in range(warmup):
-        step(x, y).wait_to_read()
+        # host read forces execution: block_until_ready alone does not
+        # drain tunneled/async backends
+        float(step(x, y).asnumpy())
     t0 = time.time()
     for _ in range(steps):
         loss = step(x, y)
-    loss.wait_to_read()
+    float(loss.asnumpy())  # syncs the whole dependency chain
     dt = time.time() - t0
 
     ips = batch * steps / dt

@@ -1,0 +1,166 @@
+"""SVRG (Stochastic Variance-Reduced Gradient) training module
+(ref: python/mxnet/contrib/svrg_optimization/svrg_module.py).
+
+SVRG periodically snapshots the weights w̃ and the full-dataset gradient
+ḡ(w̃); each minibatch update then uses the variance-reduced gradient
+    g_svrg = g_B(w) − g_B(w̃) + ḡ(w̃)
+(ref: _svrg_grads_update_rule, svrg_module.py:360). The reference splices
+this into the Module/kvstore update path with a special SVRGOptimizer; here
+the special-weight forward/backward reuses a second Executor on the same
+Symbol, and the combined gradient goes through the regular updater — no
+separate optimizer subclass needed since updates are pure functions.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...module import Module
+
+__all__ = ['SVRGModule']
+
+
+class SVRGModule(Module):
+    """Module with SVRG updates (ref: svrg_module.py:30 SVRGModule).
+
+    update_freq: take a new full-gradient snapshot every `update_freq`
+    epochs (call update_full_grads at epoch boundaries, as fit() does).
+    """
+
+    def __init__(self, symbol, data_names=('data',),
+                 label_names=('softmax_label',), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = update_freq
+        self._special_params = None   # w̃ snapshot {name: NDArray}
+        self._full_grads = None       # ḡ(w̃) {name: numpy}
+
+    # -- snapshot ------------------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot current weights as w̃ and accumulate the full-dataset
+        gradient ḡ(w̃) (ref: svrg_module.py:292 update_full_grads)."""
+        from ...ndarray.ndarray import NDArray
+        arg_params, _ = self.get_params()
+        self._special_params = {k: NDArray(v._data)
+                                for k, v in arg_params.items()}
+        sums = {k: onp.zeros(v.shape, onp.float32)
+                for k, v in arg_params.items()}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward(batch, is_train=True)
+            self.backward()
+            for name in sums:
+                grads = [e.grad_dict[name] for e in self._execs
+                         if name in e.grad_dict]
+                if grads:
+                    total = grads[0].asnumpy()
+                    for g in grads[1:]:
+                        total = total + g.asnumpy()
+                    sums[name] += total
+            nbatch += 1
+        train_data.reset()
+        if nbatch == 0:
+            raise ValueError("update_full_grads: empty data iterator")
+        self._full_grads = {k: v / nbatch for k, v in sums.items()}
+
+    def _special_batch_grads(self, data_batch):
+        """Gradient of the current batch at the snapshot weights w̃, using
+        a temporary weight swap on the same executors (ref:
+        svrg_module.py mod_aux forward/backward)."""
+        from ...ndarray.ndarray import NDArray
+        current = {k: NDArray(v._data) for k, v in self._arg_params.items()}
+        try:
+            for k, v in self._special_params.items():
+                self._arg_params[k]._data = v._data
+                for e in self._execs:
+                    e.arg_dict[k]._data = v._data
+            self.forward(data_batch, is_train=True)
+            self.backward()
+            out = {}
+            for name in self._arg_params:
+                grads = [e.grad_dict[name] for e in self._execs
+                         if name in e.grad_dict]
+                if grads:
+                    total = grads[0].asnumpy()
+                    for g in grads[1:]:
+                        total = total + g.asnumpy()
+                    out[name] = total
+            return out
+        finally:
+            for k, v in current.items():
+                self._arg_params[k]._data = v._data
+                for e in self._execs:
+                    e.arg_dict[k]._data = v._data
+
+    # -- training step -------------------------------------------------------
+    def forward_backward_svrg(self, data_batch):
+        """fwd+bwd at w, then at w̃, leaving the variance-reduced gradient
+        staged for update()."""
+        if self._special_params is None:
+            raise ValueError("call update_full_grads() before SVRG steps")
+        g_special = self._special_batch_grads(data_batch)
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        self._staged_special = g_special
+
+    def update(self):
+        """Apply g_B(w) − g_B(w̃) + ḡ(w̃) through the updater
+        (ref: _svrg_grads_update_rule, svrg_module.py:360)."""
+        if self._special_params is None or \
+                getattr(self, '_staged_special', None) is None:
+            super().update()
+            return
+        from ...ndarray.ndarray import array as nd_array
+        param_names = list(self._arg_params)
+        for idx, name in enumerate(param_names):
+            if name in self._fixed_param_names:
+                continue
+            grads = [e.grad_dict[name] for e in self._execs
+                     if name in e.grad_dict]
+            if not grads:
+                continue
+            g_curr = grads[0].asnumpy()
+            for g in grads[1:]:
+                g_curr = g_curr + g.asnumpy()
+            g_svrg = g_curr - self._staged_special[name] \
+                + self._full_grads[name]
+            weight = self._arg_params[name]
+            self._updater(idx, nd_array(g_svrg), weight)
+            for e in self._execs:
+                e.arg_dict[name]._data = weight._data
+        self._staged_special = None
+
+    # -- fit loop ------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore='local', optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.01),),
+            initializer=None, num_epoch=1, **kwargs):
+        """SVRG fit: snapshot full grads every update_freq epochs
+        (ref: svrg_module.py fit)."""
+        from ... import metric as metric_mod
+        from ... import initializer as init_mod
+        if not self.binded:
+            raise ValueError("call bind() before fit()")
+        if not self.params_initialized:
+            self.init_params(initializer or init_mod.Uniform(0.01))
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward_svrg(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(type('P', (), {
+                        'epoch': epoch, 'nbatch': nbatch,
+                        'eval_metric': eval_metric})())
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self._symbol, *self.get_params())
+        return eval_metric

@@ -140,27 +140,48 @@ class _TokenEmbedding(Vocabulary):
         self._idx_to_vec = nd_array(vecs)
 
     def _load_embedding_txt(self, file_path, elem_delim=' ',
-                            encoding='utf8'):
-        """Load `token v1 v2 ...` lines (glove/fasttext text format)."""
+                            encoding='utf8', restrict_vocab=None):
+        """Load `token v1 v2 ...` lines (glove/fasttext text format).
+        A leading fastText `count dim` header line is skipped. When
+        `restrict_vocab` is given, only its tokens are loaded and row
+        indices follow the vocabulary's own order."""
         tokens, vecs = [], []
         with open(file_path, encoding=encoding) as f:
-            for line in f:
+            for lineno, line in enumerate(f):
                 parts = line.rstrip().split(elem_delim)
                 if len(parts) < 2:
                     continue
+                if lineno == 0 and len(parts) == 2:
+                    try:  # fastText header: "<vocab_count> <dim>"
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
                 try:
                     vec = [float(x) for x in parts[1:]]
                 except ValueError:
-                    continue  # header line
+                    continue  # malformed / header-ish line
+                if vecs and len(vec) != len(vecs[0]):
+                    raise ValueError(
+                        f"{file_path}:{lineno + 1}: vector has dim "
+                        f"{len(vec)}, expected {len(vecs[0])}")
+                if restrict_vocab is not None and \
+                        parts[0] not in restrict_vocab.token_to_idx:
+                    continue
                 tokens.append(parts[0])
                 vecs.append(vec)
         if not vecs:
             raise ValueError(f"no vectors found in {file_path}")
         self._vec_len = len(vecs[0])
-        for t in tokens:
-            if t not in self._token_to_idx:
-                self._token_to_idx[t] = len(self._idx_to_token)
-                self._idx_to_token.append(t)
+        if restrict_vocab is not None:
+            # adopt the vocabulary's index space verbatim
+            self._idx_to_token = list(restrict_vocab.idx_to_token)
+            self._token_to_idx = dict(restrict_vocab.token_to_idx)
+        else:
+            for t in tokens:
+                if t not in self._token_to_idx:
+                    self._token_to_idx[t] = len(self._idx_to_token)
+                    self._idx_to_token.append(t)
         all_vecs = onp.zeros((len(self._idx_to_token), self._vec_len),
                              onp.float32)
         for t, v in zip(tokens, vecs):
@@ -174,12 +195,9 @@ class CustomEmbedding(_TokenEmbedding):
 
     def __init__(self, pretrained_file_path, elem_delim=' ',
                  encoding='utf8', vocabulary=None):
-        kwargs = {}
-        if vocabulary is not None:
-            kwargs = dict(counter=collections.Counter(
-                {t: 1 for t in vocabulary.idx_to_token[1:]}))
-        super().__init__(**kwargs)
-        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+        super().__init__()
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding,
+                                 restrict_vocab=vocabulary)
 
 
 class CompositeEmbedding(_TokenEmbedding):

@@ -62,15 +62,21 @@ def num_workers():
     return jax.process_count()
 
 
-def launch_local(script, n=2, env=None, coordinator='localhost:29500'):
+def launch_local(script, n=2, env=None, coordinator='localhost:29500',
+                 raw_command=False):
     """Spawn n local worker processes (the `--launcher local` analog of
-    tools/launch.py). Returns their exit codes."""
+    tools/launch.py; the CLI launcher delegates here so the coordinator env
+    protocol lives in one place). Returns their exit codes.
+
+    raw_command=True runs `script` verbatim; otherwise it is a python
+    script argv run under the current interpreter."""
     procs = []
+    cmd = list(script) if raw_command else [sys.executable] + list(script)
     for i in range(n):
         e = dict(os.environ)
         e.update(env or {})
         e['MXNET_TPU_COORDINATOR'] = coordinator
         e['MXNET_TPU_NUM_PROCS'] = str(n)
         e['MXNET_TPU_PROC_ID'] = str(i)
-        procs.append(subprocess.Popen([sys.executable] + script, env=e))
+        procs.append(subprocess.Popen(cmd, env=e))
     return [p.wait() for p in procs]

@@ -1,0 +1,42 @@
+"""Model zoo forward checks (ref: tests/python/unittest/test_gluon_model_zoo.py).
+A representative subset per family; the full 15-model sweep runs in CI-nightly
+fashion via scripts, not here (keeps the suite fast)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+MODELS = ['alexnet', 'squeezenet1.0', 'mobilenetv2_1.0', 'resnet18_v1',
+          'densenet121']
+
+
+@pytest.mark.parametrize('name', MODELS)
+def test_model_forward(name):
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.rand(1, 3, 224, 224).astype(onp.float32))
+    out = net(x)
+    assert out.shape == (1, 10)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        get_model('resnet9999_v9')
+
+
+def test_model_zoo_list_complete():
+    """Every family the reference model zoo ships is constructible
+    (ref: python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    for fam in ['alexnet', 'vgg11', 'vgg13', 'vgg16', 'vgg19', 'vgg11_bn',
+                'squeezenet1.0', 'squeezenet1.1', 'densenet121',
+                'densenet161', 'densenet169', 'densenet201', 'inceptionv3',
+                'mobilenet1.0', 'mobilenet0.5', 'mobilenetv2_1.0',
+                'resnet18_v1', 'resnet34_v1', 'resnet50_v1', 'resnet101_v1',
+                'resnet152_v1', 'resnet18_v2', 'resnet34_v2', 'resnet50_v2',
+                'resnet101_v2', 'resnet152_v2']:
+        net = get_model(fam, classes=10)
+        assert net is not None

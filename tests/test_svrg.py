@@ -1,0 +1,63 @@
+"""SVRG module tests (ref: tests/python/unittest/test_contrib_svrg_module.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, symbol as sym
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def _linreg_problem():
+    rng = onp.random.RandomState(0)
+    X = rng.randn(200, 5).astype(onp.float32)
+    w_true = rng.randn(5, 1).astype(onp.float32)
+    Y = (X @ w_true).astype(onp.float32)
+    data = sym.var('data')
+    w = sym.var('w', shape=(5, 1))
+    label = sym.var('lin_label')
+    loss = sym.MakeLoss(sym.mean(sym.square(sym.dot(data, w) - label)))
+    mod = SVRGModule(loss, data_names=('data',), label_names=('lin_label',),
+                     update_freq=2)
+    mod.bind(data_shapes=[('data', (20, 5))],
+             label_shapes=[('lin_label', (20, 1))])
+    it = io.NDArrayIter(X, Y, batch_size=20, label_name='lin_label')
+    mod.init_params(mx.init.Normal(0.1))
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.05),))
+    return mod, it, X, Y
+
+
+def _loss(mod, X, Y):
+    w_est = mod.get_params()[0]['w'].asnumpy()
+    return float(onp.mean((X @ w_est - Y) ** 2))
+
+
+def test_svrg_converges_on_linreg():
+    mod, it, X, Y = _linreg_problem()
+    l0 = _loss(mod, X, Y)
+    for epoch in range(6):
+        if epoch % mod.update_freq == 0:
+            mod.update_full_grads(it)
+        it.reset()
+        for batch in it:
+            mod.forward_backward_svrg(batch)
+            mod.update()
+    assert _loss(mod, X, Y) < l0 * 0.1
+
+
+def test_svrg_full_grads_snapshot():
+    mod, it, X, Y = _linreg_problem()
+    mod.update_full_grads(it)
+    assert mod._full_grads is not None and 'w' in mod._full_grads
+    # full gradient of MSE at w: 2/N X^T (Xw - y)
+    w0 = mod.get_params()[0]['w'].asnumpy()
+    expect = 2.0 / X.shape[0] * X.T @ (X @ w0 - Y)
+    got = mod._full_grads['w']
+    assert onp.allclose(got, expect, rtol=1e-3, atol=1e-4), \
+        onp.abs(got - expect).max()
+
+
+def test_svrg_fit_loop():
+    mod, it, X, Y = _linreg_problem()
+    mod.fit(it, eval_metric='mse', optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.05),), num_epoch=4)
+    assert _loss(mod, X, Y) < 0.2

@@ -11,31 +11,34 @@ mxnet_tpu.parallel.dist.init().
 Usage:
   python tools/launch.py -n 4 python train.py --epochs 1
   python tools/launch.py -n 8 -H hostfile --launcher ssh python train.py
+
+Everything after the first non-flag token is the worker command, passed
+through verbatim (flags like the worker's own -p are never consumed).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import subprocess
 import sys
 
 
-def launch_local(args, command):
-    env_extra = {}
-    if args.env:
-        for kv in args.env:
-            k, _, v = kv.partition('=')
-            env_extra[k] = v
-    procs = []
-    for i in range(args.num_workers):
-        env = dict(os.environ)
-        env.update(env_extra)
-        env['MXNET_TPU_COORDINATOR'] = f"localhost:{args.port}"
-        env['MXNET_TPU_NUM_PROCS'] = str(args.num_workers)
-        env['MXNET_TPU_PROC_ID'] = str(i)
-        procs.append(subprocess.Popen(command, env=env))
-    codes = [p.wait() for p in procs]
+def _exit_code(codes):
+    """First failing worker's code (signal deaths map to 1)."""
     return next((c if c > 0 else 1 for c in codes if c != 0), 0)
+
+
+def launch_local(args, command):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+    from mxnet_tpu.parallel.dist import launch_local as _spawn
+    env_extra = {}
+    for kv in args.env:
+        k, _, v = kv.partition('=')
+        env_extra[k] = v
+    codes = _spawn(command, n=args.num_workers, env=env_extra,
+                   coordinator=f"localhost:{args.port}", raw_command=True)
+    return _exit_code(codes)
 
 
 def launch_ssh(args, command):
@@ -52,18 +55,19 @@ def launch_ssh(args, command):
     coordinator = f"{hosts[0]}:{args.port}"
     procs = []
     for i in range(args.num_workers):
-        envs = (f"MXNET_TPU_COORDINATOR={coordinator} "
-                f"MXNET_TPU_NUM_PROCS={args.num_workers} "
-                f"MXNET_TPU_PROC_ID={i}")
-        for kv in args.env or []:
-            envs += f" {kv}"
-        remote_cmd = f"cd {os.getcwd()} && {envs} " + \
-            ' '.join(command)
+        env_pairs = [('MXNET_TPU_COORDINATOR', coordinator),
+                     ('MXNET_TPU_NUM_PROCS', str(args.num_workers)),
+                     ('MXNET_TPU_PROC_ID', str(i))]
+        for kv in args.env:
+            k, _, v = kv.partition('=')
+            env_pairs.append((k, v))
+        envs = ' '.join(f"{k}={shlex.quote(v)}" for k, v in env_pairs)
+        cmd = ' '.join(shlex.quote(c) for c in command)
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"
         procs.append(subprocess.Popen(['ssh', '-o',
                                        'StrictHostKeyChecking=no',
                                        hosts[i], remote_cmd]))
-    codes = [p.wait() for p in procs]
-    return next((c if c > 0 else 1 for c in codes if c != 0), 0)
+    return _exit_code([p.wait() for p in procs])
 
 
 def main():
@@ -84,11 +88,16 @@ def main():
     parser.add_argument('-s', '--num-servers', type=int, default=0,
                         help='ignored: the TPU backend has no server '
                              'processes (sync allreduce only)')
-    args, command = parser.parse_known_args()
+    # REMAINDER: parsing stops at the first positional, so the worker
+    # command's own flags are never consumed by the launcher
+    parser.add_argument('command', nargs=argparse.REMAINDER,
+                        help='worker command (everything after the flags)')
+    args = parser.parse_args()
+    command = args.command
+    if command and command[0] == '--':
+        command = command[1:]
     if not command:
         parser.error('no command given')
-    if command[0] == '--':
-        command = command[1:]
     if args.num_servers:
         print("note: -s/--num-servers ignored — collectives replace "
               "parameter servers", file=sys.stderr)
