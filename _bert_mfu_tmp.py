@@ -1,0 +1,42 @@
+import time, numpy as onp, jax
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.models import BertForPretraining
+from mxnet_tpu.models.bert import bert_base_config, bert_pretrain_loss
+from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+
+t_start = time.time()
+def log(msg):
+    print(f"[{time.time()-t_start:7.1f}s] {msg}", flush=True)
+
+cfg = bert_base_config()
+model = BertForPretraining(cfg)
+model.initialize(mx.init.Normal(0.02))
+log("model init done")
+batch, seq = 32, 512
+mesh = make_mesh((1,), ('dp',), devices=jax.devices()[:1])
+class LossWrap:
+    def __call__(self, mlm, nsp, labels, nsp_labels):
+        return bert_pretrain_loss(mlm, nsp, labels, nsp_labels)
+step = ShardedTrainStep(model, LossWrap(), 'adamw', {'learning_rate': 1e-4}, mesh=mesh)
+rng = onp.random.RandomState(0)
+tokens = nd.array(rng.randint(0, cfg['vocab_size'], (batch, seq)).astype(onp.int32))
+types = nd.array(onp.zeros((batch, seq), onp.int32))
+labels = nd.array(rng.randint(0, cfg['vocab_size'], (batch, seq)).astype(onp.int32))
+nsp = nd.array(rng.randint(0, 2, (batch,)).astype(onp.int32))
+for i in range(3):
+    v = float(step((tokens, types), (labels, nsp)).asnumpy())
+    log(f"warmup {i}: loss={v:.3f}")
+N = 10
+t0 = time.time()
+for i in range(N):
+    loss = step((tokens, types), (labels, nsp))
+v = float(loss.asnumpy())
+dt = (time.time() - t0) / N
+sps = batch / dt
+P = sum(int(onp.prod(p.shape)) for p in model.collect_params().values())
+tokens_per_step = batch * seq
+flops = 6 * P * tokens_per_step + 12 * cfg['layers'] * cfg['hidden'] * seq * tokens_per_step
+mfu = flops / dt / 197e12
+log(f"params={P/1e6:.1f}M step={dt*1000:.1f}ms samples/sec={sps:.2f}")
+log(f"model FLOPs/step={flops/1e12:.2f}T -> MFU={mfu*100:.1f}% (197 TFLOPs bf16 peak)")

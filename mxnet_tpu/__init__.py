@@ -49,6 +49,7 @@ from . import amp
 from . import contrib
 from . import operator
 from . import torch
+from . import rtc
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',

@@ -1,0 +1,78 @@
+"""Python side of the C predict API (driven by src/predict/c_predict_api.cc).
+
+Keeps the deployment path on the exact same executor the Python frontend
+uses: SymbolBlock + jit-compiled forward (ref: src/c_api/c_predict_api.cc,
+which rebuilt a static executor — here XLA compilation is the static
+executor).
+"""
+from __future__ import annotations
+
+import io as _pyio
+import pickle
+
+import numpy as onp
+
+__all__ = ['create', 'Predictor']
+
+
+class Predictor:
+    def __init__(self, symbol_json_str, param_bytes, input_keys,
+                 input_shapes, dev_type):
+        from . import symbol as sym_mod
+        from .gluon.block import SymbolBlock
+        from .ndarray.ndarray import array as nd_array
+
+        s = sym_mod.fromjson(symbol_json_str)
+        inputs = [sym_mod.var(k) for k in input_keys]
+        self.block = SymbolBlock(s, inputs)
+        kind, payload = pickle.loads(param_bytes)
+        if kind != 'dict':
+            raise ValueError("params file must hold a dict of arrays")
+        self.block._load_arg_dict(
+            {k: nd_array(v) for k, v in payload.items()})
+        self.input_keys = list(input_keys)
+        self.input_shapes = {k: tuple(int(d) for d in shp)
+                             for k, shp in zip(input_keys, input_shapes)}
+        self.inputs = {}
+        self.outputs = []
+
+    def set_input(self, key, data_bytes):
+        if key not in self.input_shapes:
+            raise KeyError(f"unknown input '{key}' "
+                           f"(declared: {self.input_keys})")
+        shape = self.input_shapes[key]
+        arr = onp.frombuffer(data_bytes, dtype=onp.float32)
+        expected = int(onp.prod(shape)) if shape else 1
+        if arr.size != expected:
+            raise ValueError(
+                f"input '{key}': got {arr.size} floats, shape {shape} "
+                f"needs {expected}")
+        self.inputs[key] = arr.reshape(shape)
+
+    def forward(self):
+        from .ndarray.ndarray import array as nd_array
+        missing = [k for k in self.input_keys if k not in self.inputs]
+        if missing:
+            raise ValueError(f"inputs not set: {missing}")
+        args = [nd_array(self.inputs[k]) for k in self.input_keys]
+        out = self.block(*args)
+        self.outputs = list(out) if isinstance(out, (list, tuple)) else [out]
+
+    def _out(self, index):
+        if not self.outputs:
+            raise ValueError("call forward() before reading outputs")
+        if not 0 <= index < len(self.outputs):
+            raise IndexError(f"output index {index} out of range")
+        return self.outputs[index]
+
+    def output_shape(self, index):
+        return tuple(int(d) for d in self._out(index).shape)
+
+    def output_bytes(self, index):
+        return onp.ascontiguousarray(
+            self._out(index).asnumpy().astype(onp.float32)).tobytes()
+
+
+def create(symbol_json_str, param_bytes, input_keys, input_shapes, dev_type):
+    return Predictor(symbol_json_str, param_bytes, input_keys, input_shapes,
+                     dev_type)

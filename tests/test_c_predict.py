@@ -1,0 +1,110 @@
+"""Native C predict API tests (ref: tests/python/predict/,
+include/mxnet/c_predict_api.h usage)."""
+import ctypes
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+
+LIB = os.path.join(os.path.dirname(__file__), '..', 'mxnet_tpu', '_lib',
+                   'libmxtpu_predict.so')
+
+
+@pytest.fixture(scope='module')
+def lib():
+    if not os.path.exists(LIB):
+        import subprocess
+        subprocess.run(['make', '-C',
+                        os.path.join(os.path.dirname(__file__), '..', 'src')],
+                       check=False, capture_output=True, timeout=180)
+    if not os.path.exists(LIB):
+        pytest.skip("native predict library not built")
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_uint), ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.c_void_p)]
+    return lib
+
+
+@pytest.fixture(scope='module')
+def exported_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('cpredict')
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation='relu'), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x_np = onp.random.RandomState(0).rand(2, 8).astype(onp.float32)
+    ref = net(nd.array(x_np)).asnumpy()
+    sym_f, par_f = net.export(str(tmp / 'm'))
+    return sym_f, par_f, x_np, ref
+
+
+def _create(lib, sym_f, par_f, shape):
+    sym_json = open(sym_f).read().encode()
+    params = open(par_f, 'rb').read()
+    keys = (ctypes.c_char_p * 1)(b'data')
+    indptr = (ctypes.c_uint * 2)(0, len(shape))
+    shape_data = (ctypes.c_uint * len(shape))(*shape)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json, params, len(params), 1, 0, 1, keys,
+                          indptr, shape_data, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    return handle
+
+
+def test_c_predict_matches_python(lib, exported_model):
+    sym_f, par_f, x_np, ref = exported_model
+    handle = _create(lib, sym_f, par_f, x_np.shape)
+    buf = onp.ascontiguousarray(x_np.ravel())
+    assert lib.MXPredSetInput(
+        handle, b'data',
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), buf.size) == 0
+    assert lib.MXPredForward(handle) == 0
+
+    shape_ptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_ptr),
+                                    ctypes.byref(ndim)) == 0
+    out_shape = [shape_ptr[i] for i in range(ndim.value)]
+    assert out_shape == list(ref.shape)
+    out = onp.zeros(ref.size, onp.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0
+    assert onp.allclose(out.reshape(ref.shape), ref, atol=1e-5)
+    lib.MXPredFree(handle)
+
+
+def test_c_predict_error_paths(lib, exported_model):
+    sym_f, par_f, x_np, _ = exported_model
+    handle = _create(lib, sym_f, par_f, x_np.shape)
+    buf = onp.zeros(4, onp.float32)
+    # unknown input key
+    rc = lib.MXPredSetInput(
+        handle, b'bogus',
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), buf.size)
+    assert rc == -1
+    assert b'unknown input' in lib.MXGetLastError()
+    # wrong input size
+    rc = lib.MXPredSetInput(
+        handle, b'data',
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), buf.size)
+    assert rc == -1
+    # forward without inputs set
+    rc = lib.MXPredForward(handle)
+    assert rc == -1
+    lib.MXPredFree(handle)
+    # bad params blob
+    sym_json = open(sym_f).read().encode()
+    keys = (ctypes.c_char_p * 1)(b'data')
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(2, 8)
+    h2 = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json, b'garbage', 7, 1, 0, 1, keys, indptr,
+                          shape_data, ctypes.byref(h2))
+    assert rc == -1

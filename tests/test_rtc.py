@@ -1,0 +1,55 @@
+"""Custom Pallas kernel API tests (ref: tests for mx.rtc CudaModule)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_pallas_op_elementwise():
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+    op = mx.rtc.pallas_op(scale_add, out_like=0)
+    x = nd.array(onp.random.rand(8, 128).astype(onp.float32))
+    y = nd.array(onp.random.rand(8, 128).astype(onp.float32))
+    assert_almost_equal(op(x, y), x.asnumpy() * 2 + y.asnumpy(), rtol=1e-6)
+    # kernel call cache reuses compiled fn per shape
+    assert_almost_equal(op(y, x), y.asnumpy() * 2 + x.asnumpy(), rtol=1e-6)
+
+
+def test_pallas_op_grid():
+    from jax.experimental import pallas as pl
+
+    def block_double(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    op = mx.rtc.pallas_op(
+        block_double, out_like=0, grid=(2,),
+        in_specs=[pl.BlockSpec((64, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)))
+    big = nd.array(onp.random.rand(128, 128).astype(onp.float32))
+    assert_almost_equal(op(big), big.asnumpy() * 2)
+
+
+def test_pallas_op_explicit_out_shape():
+    import jax
+
+    def rowsum(x_ref, o_ref):
+        o_ref[...] = x_ref[...].sum(axis=1, keepdims=True)
+
+    op = mx.rtc.pallas_op(
+        rowsum, out_shape=jax.ShapeDtypeStruct((8, 1), onp.float32))
+    x = nd.array(onp.random.rand(8, 16).astype(onp.float32))
+    assert_almost_equal(op(x), x.asnumpy().sum(1, keepdims=True), rtol=1e-5)
+
+
+def test_pallas_op_requires_out_spec():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.pallas_op(lambda x_ref, o_ref: None)
+
+
+def test_cuda_module_guidance():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k(){}")
