@@ -8,7 +8,6 @@ executor).
 from __future__ import annotations
 
 import io as _pyio
-import pickle
 
 import numpy as onp
 
@@ -25,9 +24,12 @@ class Predictor:
         s = sym_mod.fromjson(symbol_json_str)
         inputs = [sym_mod.var(k) for k in input_keys]
         self.block = SymbolBlock(s, inputs)
-        kind, payload = pickle.loads(param_bytes)
-        if kind != 'dict':
-            raise ValueError("params file must hold a dict of arrays")
+        # the C predict ABI is a deployment boundary — model files may come
+        # from third parties, so the params blob is parsed as the
+        # non-executable reference binary format only (no pickle;
+        # ref: src/c_api/c_predict_api.cc consumes plain NDArray payloads)
+        from .serialization import load_params_dict
+        payload = load_params_dict(param_bytes, allow_pickle=False)
         self.block._load_arg_dict(
             {k: nd_array(v) for k, v in payload.items()})
         self.input_keys = list(input_keys)

@@ -151,8 +151,10 @@ def _pool(ctx, s, ins, out):
         return
     kernel = _tuple(a.get('kernel'))
     nd = len(kernel)
+    # a pooling symbol without a 'stride' attr computes stride=1
+    # (ops/nn.py pooling default) — export must match, not kernel-stride
     attrs = {'kernel_shape': list(kernel),
-             'strides': list(_tuple(a.get('stride', kernel), nd)),
+             'strides': list(_tuple(a.get('stride', 1), nd)),
              'pads': list(_tuple(a.get('pad', 0), nd)) * 2}
     if ptype == 'avg':
         attrs['count_include_pad'] = int(a.get('count_include_pad', True))

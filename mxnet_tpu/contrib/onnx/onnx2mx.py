@@ -135,11 +135,12 @@ class _Importer:
             return self.sym_mod.pooling(x, pool_type=ptype, global_pool=True)
         kernel = _ints(a.get('kernel_shape', [1, 1]))
         pads = _ints(a.get('pads', [0] * 2 * len(kernel)))
+        # ONNX spec defaults: strides = all 1s, count_include_pad = 0
         return self.sym_mod.pooling(
             x, kernel=tuple(kernel), pool_type=ptype,
-            stride=tuple(_ints(a.get('strides', kernel))),
+            stride=tuple(_ints(a.get('strides', [1] * len(kernel)))),
             pad=self._sym_pads(f'{ptype}Pool', pads, len(kernel)),
-            count_include_pad=bool(a.get('count_include_pad', 1)))
+            count_include_pad=bool(a.get('count_include_pad', 0)))
 
     def _op_MaxPool(self, n):
         return self._pool(n, 'max', False)

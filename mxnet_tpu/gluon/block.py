@@ -186,12 +186,27 @@ class Block:
 
     # --- serialization (ref: block.py:417,473) -----------------------------
     def save_parameters(self, filename, deduplicate=False):
+        """Writes the reference's binary .params format (ref: gluon/block.py
+        save_parameters → ndarray.cc NDArray::Save) — loadable by the
+        reference and vice versa."""
+        from ..serialization import save_ndarray_file
         params = self._collect_params_with_prefix()
-        import pickle
+        if deduplicate:
+            # shared Parameter objects are stored once, under the first
+            # structured name that reaches them (reference deduplicate
+            # contract); load with allow_missing for the aliased names
+            seen = set()
+            uniq = {}
+            for key, val in params.items():
+                if id(val) in seen:
+                    continue
+                seen.add(id(val))
+                uniq[key] = val
+            params = uniq
         arg_dict = {key: val._reduce_np() if hasattr(val, '_reduce_np')
                     else val.data().asnumpy() for key, val in params.items()}
         with open(filename, 'wb') as f:
-            pickle.dump(arg_dict, f, protocol=4)
+            f.write(save_ndarray_file(arg_dict))
 
     def _collect_params_with_prefix(self, prefix=''):
         if prefix:
@@ -204,9 +219,9 @@ class Block:
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source='current'):
-        import pickle
+        from ..serialization import load_params_dict
         with open(filename, 'rb') as f:
-            loaded = pickle.load(f)
+            loaded = load_params_dict(f.read())
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return

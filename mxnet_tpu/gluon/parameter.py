@@ -399,22 +399,26 @@ class ParameterDict:
             setattr(p, name, value)
 
     def save(self, filename, strip_prefix=''):
+        """Reference binary .params container (ndarray.cc NDArray::Save)."""
+        from ..serialization import save_ndarray_file
         arg_dict = {}
         for p in self.values():
-            weight = p.data().asnumpy() if p._data is not None else None
+            if p._data is None:
+                raise MXNetError(
+                    f"Parameter '{p.name}' is uninitialized; initialize "
+                    "before save")
             name = p.name
             if name.startswith(strip_prefix):
                 name = name[len(strip_prefix):]
-            arg_dict[name] = weight
-        import pickle
+            arg_dict[name] = p.data().asnumpy()
         with open(filename, 'wb') as f:
-            pickle.dump(arg_dict, f, protocol=4)
+            f.write(save_ndarray_file(arg_dict))
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=''):
-        import pickle
+        from ..serialization import load_params_dict
         with open(filename, 'rb') as f:
-            arg_dict = pickle.load(f)
+            arg_dict = load_params_dict(f.read())
         if restore_prefix:
             arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
         for name, p in self.items():

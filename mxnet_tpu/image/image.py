@@ -536,6 +536,7 @@ class ImageIter:
         if self.imgrec is not None and self.seq is None:
             self.imgrec.reset()
         self._cursor = 0
+        self._exhausted = False
 
     def next_sample(self):
         """Returns (label, decoded HWC image array)."""
@@ -559,6 +560,11 @@ class ImageIter:
 
     def next(self):
         from ..io.io import DataBatch
+        if getattr(self, '_exhausted', False):
+            # the previous batch consumed the tail and pad-wrapped; the
+            # epoch is over even though the cursor sits mid-sequence
+            self._exhausted = False
+            raise StopIteration
         c, h, w = self.data_shape
         batch_data = onp.zeros((self.batch_size, c, h, w), self.dtype)
         batch_label = onp.zeros((self.batch_size, self.label_width),
@@ -584,6 +590,31 @@ class ImageIter:
             if self.last_batch_handle == 'discard':
                 raise
         pad = self.batch_size - i
+        if pad and self.last_batch_handle == 'pad':
+            # reference semantics: the padded tail wraps around with real
+            # samples from the start of the (re-shuffled) sequence, so
+            # consumers that ignore DataBatch.pad never see fabricated
+            # zero-image/label-0 rows. Datasets smaller than the pad wrap
+            # repeatedly.
+            self.reset()
+            start_i = i
+            while i < self.batch_size:
+                try:
+                    label, img = self.next_sample()
+                except StopIteration:
+                    if i == start_i:  # empty dataset: cannot pad
+                        break
+                    self.reset()
+                    start_i = i
+                    continue
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = _to_np(img)
+                batch_data[i] = arr.astype(self.dtype).transpose(2, 0, 1)
+                label = onp.asarray(label, onp.float32).reshape(-1)
+                batch_label[i, :self.label_width] = label[:self.label_width]
+                i += 1
+            self._exhausted = True
         if self.label_width == 1:
             batch_label = batch_label[:, 0]
         return DataBatch(data=[_nd_array(batch_data)],

@@ -1,8 +1,6 @@
 """Checkpoint helpers for the symbolic API (ref: python/mxnet/model.py)."""
 from __future__ import annotations
 
-import pickle
-
 from . import symbol as sym_mod
 from .ndarray.ndarray import array
 
@@ -10,20 +8,24 @@ from .ndarray.ndarray import array
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Ref: model.py save_checkpoint — writes prefix-symbol.json and
-    prefix-XXXX.params."""
+    prefix-XXXX.params in the reference binary format (arg:/aux: keyed,
+    ndarray.cc NDArray::Save container)."""
+    from .serialization import save_ndarray_file
     if symbol is not None:
         symbol.save(f'{prefix}-symbol.json')
     payload = {f'arg:{k}': v.asnumpy() for k, v in arg_params.items()}
     payload.update({f'aux:{k}': v.asnumpy() for k, v in aux_params.items()})
     with open(f'{prefix}-{epoch:04d}.params', 'wb') as f:
-        pickle.dump(payload, f, protocol=4)
+        f.write(save_ndarray_file(payload))
 
 
 def load_checkpoint(prefix, epoch):
-    """Ref: model.py load_checkpoint."""
+    """Ref: model.py load_checkpoint. Reads reference-format binary params
+    (round-1 pickle files still load via the restricted unpickler)."""
+    from .serialization import load_params_dict
     symbol = sym_mod.load(f'{prefix}-symbol.json')
     with open(f'{prefix}-{epoch:04d}.params', 'rb') as f:
-        payload = pickle.load(f)
+        payload = load_params_dict(f.read(), strip_arg_aux=False)
     arg_params = {}
     aux_params = {}
     for k, v in payload.items():

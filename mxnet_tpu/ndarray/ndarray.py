@@ -558,25 +558,62 @@ def waitall():
 # ---- serialization (ref: src/ndarray/ndarray.cc Save/Load + python save/load)
 
 def save(fname, data):
-    import pickle
+    """Writes the reference's dmlc binary container (ref:
+    src/ndarray/ndarray.cc NDArray::Save, kMXAPINDArrayListMagic) so files
+    interchange with the reference ecosystem."""
+    from ..serialization import save_ndarray_file
     if isinstance(data, NDArray):
-        payload = ('single', data.asnumpy())
+        payload = [data.asnumpy()]
     elif isinstance(data, (list, tuple)):
-        payload = ('list', [d.asnumpy() for d in data])
+        if not all(isinstance(d, NDArray) for d in data):
+            raise MXNetError("save expects a list of NDArrays")
+        payload = [d.asnumpy() for d in data]
     elif isinstance(data, dict):
-        payload = ('dict', {k: v.asnumpy() for k, v in data.items()})
+        payload = {k: v.asnumpy() for k, v in data.items()}
     else:
         raise MXNetError("save expects NDArray, list, or dict")
     with open(fname, 'wb') as f:
-        pickle.dump(payload, f, protocol=4)
+        f.write(save_ndarray_file(payload))
+
+
+def _decode_loaded(entry):
+    """Binary-loader entry → NDArray (densifying sparse payloads — this
+    build keeps the sparse API over dense storage)."""
+    from ..serialization import sparse_to_dense
+    if isinstance(entry, tuple):
+        return array(sparse_to_dense(*entry))
+    if entry is None:
+        return None
+    return array(entry)
 
 
 def load(fname):
-    import pickle
+    """Reads reference-format binary files; round-1 pickle files are still
+    readable through a restricted (numpy-only) unpickler."""
+    from ..serialization import (is_ndarray_file, load_ndarray_file,
+                                 safe_pickle_load)
     with open(fname, 'rb') as f:
-        kind, payload = pickle.load(f)
+        buf = f.read()
+    if is_ndarray_file(buf):
+        arrays, names = load_ndarray_file(buf)
+        if names:
+            return {k: _decode_loaded(v) for k, v in zip(names, arrays)}
+        return [_decode_loaded(a) for a in arrays]
+    import io as _io
+    kind, payload = safe_pickle_load(_io.BytesIO(buf))
     if kind == 'single':
         return array(payload)
     if kind == 'list':
         return [array(p) for p in payload]
     return {k: array(v) for k, v in payload.items()}
+
+
+def load_frombuffer(buf):
+    """Ref: mx.nd.load_frombuffer (c_api MXNDArrayLoadFromBuffer)."""
+    from ..serialization import is_ndarray_file, load_ndarray_file
+    if not is_ndarray_file(buf):
+        raise MXNetError("buffer is not an NDArray file")
+    arrays, names = load_ndarray_file(buf)
+    if names:
+        return {k: _decode_loaded(v) for k, v in zip(names, arrays)}
+    return [_decode_loaded(a) for a in arrays]

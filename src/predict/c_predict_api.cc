@@ -27,7 +27,9 @@ void set_error_from_python() {
   if (value) {
     PyObject *s = PyObject_Str(value);
     if (s) {
-      msg = PyUnicode_AsUTF8(s);
+      const char *utf8 = PyUnicode_AsUTF8(s);
+      if (utf8) msg = utf8;
+      else PyErr_Clear();  // non-UTF8-representable error text
       Py_DECREF(s);
     }
   }
