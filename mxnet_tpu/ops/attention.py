@@ -84,13 +84,32 @@ def div_sqrt_dim(data):
     return data / math.sqrt(data.shape[-1])
 
 
+def _as_key_padding_mask(mask, N, Tk):
+    """If `mask` is a key-padding mask — broadcastable (N,1,1,Tk) or
+    (N,Tk), boolean or additive — return it as (N, Tk); else None."""
+    if mask is None:
+        return None
+    shp = tuple(mask.shape)
+    if shp == (N, Tk):
+        return mask
+    if len(shp) == 4 and shp[0] in (1, N) and shp[1] == 1 and shp[2] == 1 \
+            and shp[3] == Tk:
+        m = mask.reshape(shp[0], Tk)
+        if shp[0] == 1:
+            m = jnp.broadcast_to(m, (N, Tk))
+        return m
+    return None
+
+
 @_reg
 def multi_head_attention(query, key, value, mask=None, num_heads=1,
                          dropout_p=0.0, causal=False, use_pallas='auto'):
     """Fused MHA on (N, T, H*D)-shaped q/k/v. The TPU-native attention entry.
 
-    use_pallas: 'auto' picks the Pallas flash kernel on TPU for long
-    sequences, plain XLA otherwise (XLA already fuses softmax well at small T).
+    use_pallas: 'auto' routes through the Pallas flash kernel whenever an
+    accelerator backend is active and the mask (if any) is a key-padding
+    mask — this covers the flagship BERT@512-with-padding-mask config.
+    Arbitrary (per-query) masks fall back to the XLA path.
     """
     N, Tq, tot = query.shape
     H = num_heads
@@ -100,14 +119,15 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
     v = value.reshape(N, value.shape[1], H, D).transpose(0, 2, 1, 3)
 
     if use_pallas in ('auto', True):
-        try:
-            from .pallas_attention import flash_attention, pallas_available
-            if pallas_available() and (use_pallas is True or
-                                       (Tq >= 1024 and mask is None)):
-                out = flash_attention(q, k, v, causal=causal)
-                return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
-        except Exception:
-            pass
+        from .pallas_attention import flash_attention, pallas_available
+        kpm = _as_key_padding_mask(mask, N, k.shape[2])
+        if (use_pallas is True or pallas_available()) and \
+                (mask is None or kpm is not None):
+            if kpm is not None:
+                # same semantics as the XLA path below: truthy = keep
+                kpm = kpm.astype(jnp.bool_)
+            out = flash_attention(q, k, v, key_mask=kpm, causal=causal)
+            return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
 
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum('nhqd,nhkd->nhqk', q * scale, k,

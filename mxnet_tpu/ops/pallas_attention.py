@@ -1,10 +1,21 @@
-"""Pallas flash-attention kernel for TPU (placeholder-free entry point).
+"""Pallas flash-attention kernel for TPU.
 
-The fused MHA op (ops/attention.py multi_head_attention) routes here for
-long sequences on TPU. `flash_attention` currently delegates to a
-blockwise-XLA implementation with online softmax (same memory behavior as
-flash attention: no T×T materialisation in HBM thanks to XLA fusion over
-the scan); a hand-written Pallas kernel drops in behind the same signature.
+The fused MHA op (ops/attention.py multi_head_attention) routes here. This
+is the TPU-native realisation of the reference's interleaved_matmul
+attention kernels (ref: src/operator/contrib/transformer.cc:650-828): one
+hand-written kernel instead of two batched-GEMM ops, with the T×T score
+matrix living only in VMEM.
+
+Layout: grid (B*H, Tq/BQ, Tk/BK), k-block dimension innermost. Scratch
+(VMEM) carries the online-softmax state (running max m, running sum l,
+f32 accumulator) across k-blocks; the final k-block normalises and writes
+the output block plus the logsumexp (saved for the backward pass).
+
+The backward is a blockwise lax.scan over k-blocks using the saved LSE —
+same O(T) memory behavior, XLA-fused matmuls on the MXU.
+
+`flash_attention(..., interpret=True)` runs the identical kernel through
+the Pallas interpreter so CPU tests exercise the real kernel code.
 """
 from __future__ import annotations
 
@@ -14,59 +25,266 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30
 
 
 def pallas_available() -> bool:
+    if not _HAS_PLTPU:
+        return False
     try:
-        return any(d.platform not in ('cpu',) for d in jax.devices())
+        return any(d.platform == 'tpu' for d in jax.devices())
     except Exception:
         return False
 
 
-@functools.partial(jax.jit, static_argnames=('causal', 'block_k'))
-def flash_attention(q, k, v, causal=False, block_k=512):
-    """q/k/v: (B, H, T, D). Blockwise attention with online softmax — scans
-    over K/V blocks so the T×T score matrix never hits HBM."""
+def _block_sizes(Tq, Tk, D, dtype):
+    """Pick MXU/VPU-aligned block sizes. Sublane minimum is 8 (f32) /
+    16 (bf16); lanes are 128."""
+    min_sub = 16 if dtype == jnp.bfloat16 else 8
+    bq = max(min_sub, min(128, Tq))
+    bk = max(min_sub, min(512, Tk))
+    return bq, bk
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref,
+               acc_ref, m_ref, l_ref, *, scale, causal, bq, bk,
+               q_len, k_len):
+    """One (q-block, k-block) cell. Refs are VMEM blocks:
+    q (1, bq, D), k/v (1, bk, D), kmask (1, bk) additive f32,
+    o (1, bq, D), lse (1, bq); scratch acc (bq, D) f32, m/l (bq, 128)."""
+    kb = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                     # (bq, D)
+    k = k_ref[0]                                     # (bk, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+    # key-side validity: padding beyond k_len + user key mask
+    k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    s = jnp.where(k_pos < k_len, s, _NEG_INF)
+    if kmask_ref is not None:
+        s = s + kmask_ref[0][None, :]
+    if causal:
+        q_pos = pl.program_id(1) * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, 1), 0)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]                            # (bq, 1)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                           # (bq, bk) f32
+    alpha = jnp.exp(m_prev - m_new)                  # (bq, 1)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(safe_l))[:, 0]
+
+
+def _fa_forward(q, k, v, kmask, causal, interpret):
+    """q/k/v: (BH, T, D) flattened over batch*heads.
+    kmask: (BH, Tk) additive f32 or None. Returns (out, lse)."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    bq, bk = _block_sizes(Tq, Tk, D, q.dtype)
+    nq, nk = pl.cdiv(Tq, bq), pl.cdiv(Tk, bk)
+    pq, pk = nq * bq - Tq, nk * bk - Tk
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
+        if kmask is not None:
+            kmask = jnp.pad(kmask, ((0, 0), (0, pk)))
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        q_len=Tq, k_len=Tk)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if kmask is not None:
+        in_specs.append(pl.BlockSpec((1, bk), lambda b, i, j: (b, j)))
+        args.append(kmask.astype(jnp.float32))
+        krn = kernel
+    else:
+        krn = functools.partial(_wrap_no_mask, kernel)
+    scratch = [pltpu.VMEM((bq, D), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32)]
+    out, lse = pl.pallas_call(
+        krn,
+        grid=(BH, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, nq * bq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, nq * bq), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    if pq:
+        out = out[:, :Tq]
+        lse = lse[:, :Tq]
+    return out, lse
+
+
+def _wrap_no_mask(kernel, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref):
+    kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+           acc_ref, m_ref, l_ref)
+
+
+def _fa_backward(q, k, v, kmask, causal, out, lse, do):
+    """Blockwise backward over k-blocks using the saved LSE (flash
+    attention backward recurrence); O(T) live memory, MXU matmuls."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    bk = max(8, min(512, Tk))
+    nk = (Tk + bk - 1) // bk
+    pk = nk * bk - Tk
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
+        if kmask is not None:
+            kmask = jnp.pad(kmask, ((0, 0), (0, pk)),
+                            constant_values=_NEG_INF)
+    q32, do32 = q.astype(jnp.float32), do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # (BH, Tq)
+    kb = k.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
+    vb = v.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
+    mb = (kmask.reshape(BH, nk, bk).transpose(1, 0, 2)
+          if kmask is not None else None)
+    q_pos = jnp.arange(Tq)
+
+    def body(dq_acc, blk):
+        idx, k_cur, v_cur, m_cur = blk
+        s = jnp.einsum('bqd,bkd->bqk', q32, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = idx * bk + jnp.arange(bk)
+        s = jnp.where((k_pos < Tk)[None, None, :], s, _NEG_INF)
+        if m_cur is not None:
+            s = s + m_cur[:, None, :]
+        if causal:
+            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :],
+                          s, _NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])                     # (BH, Tq, bk)
+        dv = jnp.einsum('bqk,bqd->bkd', p, do32,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum('bqd,bkd->bqk', do32, v_cur.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dq_acc = dq_acc + jnp.einsum('bqk,bkd->bqd', ds,
+                                     k_cur.astype(jnp.float32),
+                                     preferred_element_type=jnp.float32)
+        dk = jnp.einsum('bqk,bqd->bkd', ds, q32,
+                        preferred_element_type=jnp.float32)
+        return dq_acc, (dk, dv)
+
+    idxs = jnp.arange(nk)
+    blks = (idxs, kb, vb) if mb is None else (idxs, kb, vb, mb)
+
+    def scan_body(dq_acc, xs):
+        if mb is None:
+            i, kc, vc = xs
+            return body(dq_acc, (i, kc, vc, None))
+        i, kc, vc, mc = xs
+        return body(dq_acc, (i, kc, vc, mc))
+
+    dq, (dks, dvs) = lax.scan(scan_body, jnp.zeros_like(q32), blks)
+    dk = dks.transpose(1, 0, 2, 3).reshape(BH, nk * bk, D)[:, :Tk]
+    dv = dvs.transpose(1, 0, 2, 3).reshape(BH, nk * bk, D)[:, :Tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kmask, causal, interpret):
+    out, _ = _fa_forward(q, k, v, kmask, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, kmask, causal, interpret):
+    out, lse = _fa_forward(q, k, v, kmask, causal, interpret)
+    return out, (q, k, v, kmask, out, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    q, k, v, kmask, out, lse = res
+    dq, dk, dv = _fa_backward(q, k, v, kmask, causal, out, lse, do)
+    dmask = None if kmask is None else jnp.zeros_like(kmask)
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, key_mask=None, causal=False, block_k=None,
+                    interpret=False):
+    """Flash attention. q/k/v: (B, H, T, D). key_mask: optional (B, Tk)
+    additive f32 mask (0 = keep, large-negative = drop) or boolean
+    (True = keep). Returns (B, H, Tq, D).
+
+    On TPU this is a Pallas kernel (VMEM online softmax); on CPU backends
+    the same kernel runs through the Pallas interpreter (tests exercise
+    the real kernel code)."""
+    if not interpret:
+        try:
+            interpret = jax.default_backend() == 'cpu'
+        except Exception:
+            interpret = True
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    scale = 1.0 / math.sqrt(D)
-    block_k = min(block_k, Tk)
-    nblocks = (Tk + block_k - 1) // block_k
-    pad = nblocks * block_k - Tk
-    if pad:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-    kb = k.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
-    vb = v.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
-
-    q32 = q.astype(jnp.bfloat16) if q.dtype == jnp.bfloat16 else q
-
-    def body(carry, kv):
-        acc, m_prev, l_prev, blk = carry
-        k_cur, v_cur = kv
-        scores = jnp.einsum('bhqd,bhkd->bhqk', q32, k_cur,
-                            preferred_element_type=jnp.float32) * scale
-        k_pos = blk * block_k + jnp.arange(block_k)
-        valid = k_pos < Tk
-        if causal:
-            q_pos = jnp.arange(Tq)
-            cmask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(cmask & valid[None, :], scores, -1e30)
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    km = None
+    if key_mask is not None:
+        if key_mask.dtype == jnp.bool_:
+            key_mask = jnp.where(key_mask, 0.0, _NEG_INF)
+        key_mask = key_mask.astype(jnp.float32)
+        if key_mask.shape[0] == B * H:
+            km = key_mask
+        elif key_mask.shape[0] == B:
+            km = jnp.broadcast_to(key_mask[:, None, :],
+                                  (B, H, Tk)).reshape(B * H, Tk)
         else:
-            scores = jnp.where(valid[None, :], scores, -1e30)
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(scores - m_new)
-        l_cur = jnp.sum(p, axis=-1, keepdims=True)
-        alpha = jnp.exp(m_prev - m_new)
-        acc = acc * alpha + jnp.einsum('bhqk,bhkd->bhqd',
-                                       p.astype(v_cur.dtype), v_cur)
-        l_new = l_prev * alpha + l_cur
-        return (acc, m_new, l_new, blk + 1), None
-
-    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
-    m0 = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
-    (acc, m, l, _), _ = lax.scan(body, (acc0, m0, l0, 0), (kb, vb))
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+            raise ValueError(
+                f"key_mask leading dim {key_mask.shape[0]} matches neither "
+                f"batch {B} nor batch*heads {B * H}")
+    out = _flash(qf, kf, vf, km, causal, interpret)
+    return out.reshape(B, H, Tq, D)

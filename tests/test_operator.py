@@ -294,25 +294,69 @@ def test_attention_ops():
     assert_almost_equal(fused, ref, rtol=1e-4, atol=1e-5)
 
 
-def test_flash_attention_matches_naive():
-    from mxnet_tpu.ops.pallas_attention import flash_attention
+def _naive_mha(q, k, v, key_mask=None, causal=False):
+    import jax
     import jax.numpy as jnp
-    B, H, T, D = 2, 2, 16, 4
+    D = q.shape[-1]
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(jnp.float32) \
+        / onp.sqrt(D)
+    if key_mask is not None:
+        s = s + key_mask[:, None, None, :]
+    if causal:
+        cm = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+        s = jnp.where(cm, s, -1e30)
+    att = jax.nn.softmax(s, -1).astype(q.dtype)
+    return jnp.einsum('bhqk,bhkd->bhqd', att, v)
+
+
+def test_flash_attention_matches_naive():
+    """The real Pallas kernel (interpret mode on CPU) vs naive attention:
+    forward and backward, with/without causal and key-padding masks, on a
+    non-block-aligned sequence length."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_attention import flash_attention
+    B, H, T, D = 2, 2, 20, 8   # T=20 exercises block padding
     q = jnp.asarray(_r(B, H, T, D))
     k = jnp.asarray(_r(B, H, T, D))
     v = jnp.asarray(_r(B, H, T, D))
-    out = flash_attention(q, k, v, block_k=8)
-    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) / onp.sqrt(D)
-    p = jnp.exp(s - s.max(-1, keepdims=True))
-    p = p / p.sum(-1, keepdims=True)
-    ref = jnp.einsum('bhqk,bhkd->bhqd', p, v)
-    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=1e-4,
-                        atol=1e-5)
-    out_c = flash_attention(q, k, v, causal=True, block_k=8)
-    mask = onp.tril(onp.ones((T, T), bool))
-    s2 = onp.asarray(s)
-    s2 = onp.where(mask, s2, -1e30)
-    p2 = onp.exp(s2 - s2.max(-1, keepdims=True))
-    p2 = p2 / p2.sum(-1, keepdims=True)
-    ref_c = onp.einsum('bhqk,bhkd->bhqd', p2, onp.asarray(v))
-    assert_almost_equal(onp.asarray(out_c), ref_c, rtol=1e-4, atol=1e-5)
+    vlen = jnp.array([13, 20])
+    kmask = jnp.where(jnp.arange(T)[None, :] < vlen[:, None],
+                      0.0, -1e30).astype(jnp.float32)
+    for causal in (False, True):
+        for m in (None, kmask):
+            out = flash_attention(q, k, v, key_mask=m, causal=causal)
+            ref = _naive_mha(q, k, v, m, causal)
+            assert_almost_equal(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-5)
+
+    def loss(fn, m):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, m) * jnp.cos(
+            jnp.arange(D, dtype=jnp.float32)))
+    for m in (None, kmask):
+        g_fa = jax.grad(loss(lambda q, k, v, m: flash_attention(
+            q, k, v, key_mask=m), m), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(_naive_mha, m), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            assert_almost_equal(onp.asarray(a), onp.asarray(b),
+                                rtol=1e-4, atol=2e-5)
+
+
+def test_mha_op_pallas_routing_matches_xla():
+    """multi_head_attention with use_pallas=True (kernel path) equals the
+    XLA path for key-padding masks — the flagship BERT@512 mask shape."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import multi_head_attention
+    N, T, H, D = 2, 24, 2, 8
+    q = jnp.asarray(_r(N, T, H * D))
+    k = jnp.asarray(_r(N, T, H * D))
+    v = jnp.asarray(_r(N, T, H * D))
+    vlen = jnp.array([15, 24])
+    mask = (jnp.arange(T)[None, None, None, :] <
+            vlen[:, None, None, None])          # (N,1,1,T) boolean keep
+    out_pl = multi_head_attention(q, k, v, mask=mask, num_heads=H,
+                                  use_pallas=True)
+    out_xla = multi_head_attention(q, k, v, mask=mask, num_heads=H,
+                                   use_pallas=False)
+    assert_almost_equal(onp.asarray(out_pl), onp.asarray(out_xla),
+                        rtol=1e-4, atol=1e-5)
