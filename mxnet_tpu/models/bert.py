@@ -34,6 +34,7 @@ class BertSelfAttention(HybridBlock):
         super().__init__(**kwargs)
         self._heads = heads
         self._hidden = hidden
+        self._attn_dropout = dropout
         with self.name_scope():
             self.qkv = nn.Dense(3 * hidden, flatten=False, in_units=hidden)
             self.proj = nn.Dense(hidden, flatten=False, in_units=hidden)
@@ -44,7 +45,7 @@ class BertSelfAttention(HybridBlock):
         qkv = self.qkv(x)
         q, k, v = qkv.split(3, axis=-1)
         out = _invoke(attn_ops.multi_head_attention, q, k, v, mask,
-                      num_heads=self._heads)
+                      num_heads=self._heads, dropout_p=self._attn_dropout)
         return self.dropout(self.proj(out))
 
 

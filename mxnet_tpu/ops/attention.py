@@ -13,7 +13,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..base import register_op
+from ..base import register_op, state as _flags
+from .. import random as _random
 
 __all__ = []
 
@@ -86,7 +87,10 @@ def div_sqrt_dim(data):
 
 def _as_key_padding_mask(mask, N, Tk):
     """If `mask` is a key-padding mask — broadcastable (N,1,1,Tk) or
-    (N,Tk), boolean or additive — return it as (N, Tk); else None."""
+    (N,Tk) — return it as (N, Tk) preserving its dtype; else None.
+    Mask convention (both attention paths, torch-style): boolean/integer
+    masks are keep/drop (truthy = keep); floating masks are ADDITIVE
+    (0.0 = keep, large-negative = drop) and are added to the scores."""
     if mask is None:
         return None
     shp = tuple(mask.shape)
@@ -101,15 +105,32 @@ def _as_key_padding_mask(mask, N, Tk):
     return None
 
 
+_pallas_fallback_warned = [False]
+
+
 @_reg
 def multi_head_attention(query, key, value, mask=None, num_heads=1,
-                         dropout_p=0.0, causal=False, use_pallas='auto'):
+                         dropout_p=0.0, causal=False, use_pallas='auto',
+                         dropout_key=None):
     """Fused MHA on (N, T, H*D)-shaped q/k/v. The TPU-native attention entry.
+
+    Mask convention (torch-style, identical on both paths): boolean/integer
+    masks are keep/drop (truthy = keep); floating masks are ADDITIVE
+    (0.0 = keep, large-negative = drop), added to the pre-softmax scores.
 
     use_pallas: 'auto' routes through the Pallas flash kernel whenever an
     accelerator backend is active and the mask (if any) is a key-padding
     mask — this covers the flagship BERT@512-with-padding-mask config.
-    Arbitrary (per-query) masks fall back to the XLA path.
+    Arbitrary (per-query) masks fall back to the XLA path. Under 'auto' a
+    Pallas trace failure degrades to the XLA path with a one-time warning;
+    use_pallas=True raises.
+
+    dropout_p: attention-probability dropout, applied after softmax (the
+    standard transformer recipe), active in autograd training mode (same
+    gate as the dropout op). The PRNG key comes from the framework key
+    provider unless dropout_key overrides it. Attention dropout routes
+    through the XLA path (the Pallas kernel never materialises the
+    probability matrix); set dropout_p=0 for the max-MFU configuration.
     """
     N, Tq, tot = query.shape
     H = num_heads
@@ -118,16 +139,30 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
     k = key.reshape(N, key.shape[1], H, D).transpose(0, 2, 1, 3)
     v = value.reshape(N, value.shape[1], H, D).transpose(0, 2, 1, 3)
 
-    if use_pallas in ('auto', True):
+    apply_dropout = dropout_p > 0.0 and (dropout_key is not None
+                                         or _flags.is_training)
+
+    if use_pallas in ('auto', True) and not apply_dropout:
         from .pallas_attention import flash_attention, pallas_available
         kpm = _as_key_padding_mask(mask, N, k.shape[2])
         if (use_pallas is True or pallas_available()) and \
                 (mask is None or kpm is not None):
-            if kpm is not None:
-                # same semantics as the XLA path below: truthy = keep
-                kpm = kpm.astype(jnp.bool_)
-            out = flash_attention(q, k, v, key_mask=kpm, causal=causal)
-            return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
+            if kpm is not None and not jnp.issubdtype(kpm.dtype,
+                                                      jnp.floating):
+                kpm = kpm.astype(jnp.bool_)  # truthy = keep
+            try:
+                out = flash_attention(q, k, v, key_mask=kpm, causal=causal)
+                return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
+            except Exception:
+                if use_pallas is True:
+                    raise
+                if not _pallas_fallback_warned[0]:
+                    _pallas_fallback_warned[0] = True
+                    import warnings
+                    warnings.warn(
+                        "Pallas flash attention failed to trace; falling "
+                        "back to the XLA attention path for this process.",
+                        RuntimeWarning)
 
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum('nhqd,nhkd->nhqk', q * scale, k,
@@ -137,7 +172,16 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
         cmask = jnp.tril(jnp.ones((Tq, Tk), bool))
         scores = jnp.where(cmask, scores, -1e30)
     if mask is not None:
-        scores = jnp.where(mask.astype(bool), scores, -1e30)
+        if jnp.issubdtype(mask.dtype, jnp.floating):
+            scores = scores + mask.astype(scores.dtype)
+        else:
+            scores = jnp.where(mask.astype(bool), scores, -1e30)
     att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if apply_dropout:
+        if dropout_key is None:
+            dropout_key = _random.next_key()
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, att.shape)
+        att = jnp.where(keep, att / (1.0 - dropout_p),
+                        jnp.zeros_like(att)).astype(q.dtype)
     out = jnp.einsum('nhqk,nhkd->nhqd', att, v)
     return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)

@@ -139,6 +139,7 @@ class ShardedTrainStep:
         self.param_specs = param_specs or {}
         self.donate = donate
         self._params = None       # list[(name, Parameter)]
+        self._master = None       # fp32 master copies of bf16/fp16 params
         self._opt_state = None
         self._compiled = None
         self._step_count = 0
@@ -150,16 +151,55 @@ class ShardedTrainStep:
         frozen = [(n, p) for n, p in params if p.grad_req == 'null']
         return trainable, frozen
 
-    def _spec_for(self, name):
+    def _resolve_param_specs(self, names):
+        """name -> PartitionSpec. A spec key matches a parameter by exact
+        name or as a regex via re.search (so plain substrings keep
+        working). Unmatched specs and conflicting matches warn; the full
+        mapping is kept on self.param_spec_report for inspection."""
+        import re
+        import warnings
+        mapping = {n: P() for n in names}
+        matched_by = {n: None for n in names}
+        report = {}
         for pat, spec in self.param_specs.items():
-            if pat in name:
-                return spec
+            hits = [n for n in names
+                    if n == pat or re.search(str(pat), n) is not None]
+            report[pat] = hits
+            if not hits:
+                warnings.warn(
+                    f"ShardedTrainStep: param_spec {pat!r} matched no "
+                    f"parameter (have e.g. {sorted(names)[:5]})",
+                    RuntimeWarning)
+            for n in hits:
+                if matched_by[n] is not None and mapping[n] != spec:
+                    warnings.warn(
+                        f"ShardedTrainStep: parameter {n!r} matched both "
+                        f"{matched_by[n]!r} and {pat!r}; using {pat!r}",
+                        RuntimeWarning)
+                mapping[n] = spec
+                matched_by[n] = pat
+        self.param_spec_report = report
+        return mapping
+
+    def _spec_for(self, name):
+        if getattr(self, '_spec_map', None) is not None and \
+                name in self._spec_map:
+            return self._spec_map[name]
         return P()  # replicated
 
     def _build(self, example_inputs, example_labels):
         trainable, frozen = self._collect()
         t_names = [n for n, _ in trainable]
         f_names = [n for n, _ in frozen]
+        self._spec_map = self._resolve_param_specs(t_names + f_names)
+        # low-precision trainables keep a persistent fp32 master copy
+        # (the reference's create_state_multi_precision,
+        # python/mxnet/optimizer/optimizer.py:52): without it, updates
+        # below the bf16 ulp of the weight are lost to re-rounding.
+        master_names = frozenset(
+            n for n, p in trainable
+            if jnp.dtype(p.data()._data.dtype).itemsize < 4
+            and jnp.issubdtype(p.data()._data.dtype, jnp.floating))
         block = self.block
         loss_fn = self.loss_fn
         opt_update = self._opt_update
@@ -189,20 +229,25 @@ class ShardedTrainStep:
             aux = {n: proxies[n]._data for n in f_names}
             return loss_val, aux
 
-        def train_step(t_params, f_params, opt_state, inputs, labels, key, lr):
+        def train_step(t_params, f_params, master, opt_state, inputs,
+                       labels, key, lr):
             (loss_val, aux), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(t_params, f_params, inputs,
                                             labels, key)
             new_params = {}
+            new_master = {}
             new_state = {}
             for n in t_names:
-                p32 = t_params[n].astype(jnp.float32)
                 g32 = grads[n].astype(jnp.float32)
+                p32 = master[n] if n in master_names \
+                    else t_params[n].astype(jnp.float32)
                 np_, ns_ = opt_update(p32, g32, opt_state[n], lr, **opt_kwargs)
                 new_params[n] = np_.astype(t_params[n].dtype)
+                if n in master_names:
+                    new_master[n] = np_
                 new_state[n] = ns_
             new_f = {n: aux.get(n, f_params[n]) for n in f_names}
-            return new_params, new_f, new_state, loss_val
+            return new_params, new_f, new_master, new_state, loss_val
 
         # shardings
         mesh = self.mesh
@@ -219,15 +264,20 @@ class ShardedTrainStep:
                      for s in self._opt_state[n])
             for n in t_names}
 
-        in_shardings = (t_shardings, f_shardings, state_shardings,
+        master_shardings = {n: t_shardings[n] for n in master_names}
+        in_shardings = (t_shardings, f_shardings, master_shardings,
+                        state_shardings,
                         tuple(batch_sh for _ in example_inputs),
                         tuple(batch_sh for _ in example_labels),
                         repl, repl)
-        out_shardings = (t_shardings, f_shardings, state_shardings, repl)
-        donate = (0, 2) if self.donate else ()
+        out_shardings = (t_shardings, f_shardings, master_shardings,
+                         state_shardings, repl)
+        donate = (0, 2, 3) if self.donate else ()
         self._compiled = jax.jit(train_step, in_shardings=in_shardings,
                                  out_shardings=out_shardings,
                                  donate_argnums=donate)
+        self._master_names = master_names
+        self._master_shardings = master_shardings
         self._t_names = t_names
         self._f_names = f_names
         self._trainable = trainable
@@ -273,6 +323,10 @@ class ShardedTrainStep:
             for n, p in self._frozen:
                 p._data[0]._data = _put_replicated(p.data()._data,
                                                    self._f_shardings[n])
+            self._master = {
+                n: _put_replicated(p.data()._data.astype(jnp.float32),
+                                   self._master_shardings[n])
+                for n, p in self._trainable if n in self._master_names}
             self._opt_state = {
                 n: tuple(_put_replicated(
                     s, NamedSharding(self.mesh, P()) if s.ndim == 0
@@ -286,13 +340,14 @@ class ShardedTrainStep:
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
         in_datas = tuple(_put_batch(x, self._batch_sh) for x in in_datas)
         lab_datas = tuple(_put_batch(x, self._batch_sh) for x in lab_datas)
-        new_t, new_f, new_state, loss = self._compiled(
-            t_params, f_params, self._opt_state, in_datas, lab_datas, key,
-            lr_val)
+        new_t, new_f, new_master, new_state, loss = self._compiled(
+            t_params, f_params, self._master, self._opt_state, in_datas,
+            lab_datas, key, lr_val)
         for n, p in self._trainable:
             p.data()._data = new_t[n]
         for n, p in self._frozen:
             p.data()._data = new_f[n]
+        self._master = new_master
         self._opt_state = new_state
         self._step_count += 1
         return NDArray(_local_value(loss))

@@ -132,6 +132,8 @@ def read_ndarray(f):
     dtype = _FLAG_TO_DTYPE[flag]
     aux = []
     if naux:
+        if storage_shape is None:
+            raise FormatError("sparse ndarray with unknown storage_shape")
         aux_meta = []
         for _ in range(naux):
             aflag, = struct.unpack('<i', _read_exact(f, 4))
@@ -164,7 +166,9 @@ def _read_legacy(f, magic):
         if ndim > 32:
             raise FormatError(f"bad NDArray magic 0x{magic:x}")
         shape = struct.unpack(f'<{ndim}I', _read_exact(f, 4 * ndim))
-    if len(shape) == 0:
+    # shape_is_none (ndim < 0) and empty shape are both none-arrays in the
+    # reference's LegacyLoad
+    if shape is None or len(shape) == 0:
         return None
     _read_exact(f, 8)                             # context
     flag, = struct.unpack('<i', _read_exact(f, 4))

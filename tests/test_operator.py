@@ -360,3 +360,56 @@ def test_mha_op_pallas_routing_matches_xla():
                                    use_pallas=False)
     assert_almost_equal(onp.asarray(out_pl), onp.asarray(out_xla),
                         rtol=1e-4, atol=1e-5)
+
+
+def test_mha_additive_float_mask():
+    """Floating masks are ADDITIVE (0 keep / -1e30 drop) on both attention
+    paths; boolean masks are keep/drop. The two conventions must agree
+    (advisor r2: additive masks were silently inverted by a bool cast)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import multi_head_attention
+    N, T, H, D = 2, 24, 2, 8
+    q = jnp.asarray(_r(N, T, H * D))
+    k = jnp.asarray(_r(N, T, H * D))
+    v = jnp.asarray(_r(N, T, H * D))
+    vlen = jnp.array([15, 24])
+    keep = jnp.arange(T)[None, :] < vlen[:, None]          # (N, T) bool
+    bool_mask = keep[:, None, None, :]
+    add_mask = jnp.where(bool_mask, 0.0, -1e30).astype(jnp.float32)
+    out_bool = multi_head_attention(q, k, v, mask=bool_mask, num_heads=H,
+                                    use_pallas=False)
+    out_add = multi_head_attention(q, k, v, mask=add_mask, num_heads=H,
+                                   use_pallas=False)
+    assert_almost_equal(onp.asarray(out_add), onp.asarray(out_bool),
+                        rtol=1e-4, atol=1e-5)
+    # pallas (interpreted) path, additive key-padding mask form
+    out_add_pl = multi_head_attention(q, k, v, mask=add_mask, num_heads=H,
+                                      use_pallas=True)
+    assert_almost_equal(onp.asarray(out_add_pl), onp.asarray(out_bool),
+                        rtol=1e-4, atol=1e-5)
+    # sanity: the mask actually drops keys (row 0 differs from unmasked)
+    out_nomask = multi_head_attention(q, k, v, num_heads=H,
+                                      use_pallas=False)
+    assert onp.abs(onp.asarray(out_bool) - onp.asarray(out_nomask)).max() \
+        > 1e-3
+
+
+def test_mha_attention_dropout():
+    """dropout_p is applied in training mode (stochastic, scaled) and a
+    no-op in inference mode (advisor r2: it was a silent dead parameter)."""
+    import jax.numpy as jnp
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops.attention import multi_head_attention
+    N, T, H, D = 2, 16, 2, 8
+    q = jnp.asarray(_r(N, T, H * D))
+    k = jnp.asarray(_r(N, T, H * D))
+    v = jnp.asarray(_r(N, T, H * D))
+    base = multi_head_attention(q, k, v, num_heads=H, dropout_p=0.5,
+                                use_pallas=False)
+    with autograd.train_mode():
+        d1 = multi_head_attention(q, k, v, num_heads=H, dropout_p=0.5,
+                                  use_pallas=False)
+        d2 = multi_head_attention(q, k, v, num_heads=H, dropout_p=0.5,
+                                  use_pallas=False)
+    assert onp.abs(onp.asarray(d1) - onp.asarray(base)).max() > 1e-3
+    assert onp.abs(onp.asarray(d1) - onp.asarray(d2)).max() > 1e-3

@@ -182,3 +182,52 @@ def test_sync_batchnorm_in_shard_map():
     expect = (xn - mean[None, :, None, None]) / onp.sqrt(
         var[None, :, None, None] + 1e-5)
     assert_almost_equal(onp.asarray(out), expect, rtol=1e-3, atol=1e-4)
+
+
+def test_bf16_master_weights():
+    """bf16 params keep a persistent fp32 master copy: updates below the
+    bf16 ulp accumulate instead of being lost to re-rounding each step
+    (ref: create_state_multi_precision, optimizer/optimizer.py:52)."""
+    mesh = make_mesh((8,), ('dp',))
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize()
+    net.weight.set_data(nd.array(onp.ones((1, 1), onp.float32)))
+    net.cast('bfloat16')
+
+    def loss_fn(out, label):
+        return out.reshape(-1)  # dL/dw = x = 1
+
+    step = ShardedTrainStep(net, loss_fn, 'sgd',
+                            {'learning_rate': 1e-3, 'momentum': 0.0,
+                             'wd': 0.0}, mesh=mesh)
+    x = nd.array(onp.ones((8, 1), onp.float32))
+    y = nd.array(onp.zeros((8, 1), onp.float32))
+    for _ in range(10):
+        step(x, y)
+    # without a master copy: 1.0 - 1e-3 rounds back to 1.0 (bf16 ulp at
+    # 1.0 is 2^-8 ≈ 3.9e-3) and the weight never moves
+    w = net.weight.data().asnumpy().astype(onp.float32)
+    master = float(onp.asarray(step._master[net.weight.name]))
+    assert abs(master - (1.0 - 10e-3)) < 1e-6
+    assert w[0, 0] < 1.0  # rounded from the master, has actually moved
+    # the bf16 weight is exactly the master rounded to bf16
+    assert w[0, 0] == onp.asarray(
+        jnp.asarray(master, jnp.bfloat16).astype(jnp.float32))
+
+
+def test_param_spec_matching_reports_and_warns():
+    """param_specs match by exact name or regex; unmatched specs warn
+    (advisor r1/r2: bare substring matching was silent and greedy)."""
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh((2, 4), ('dp', 'tp'))
+    net = nn.Dense(8, in_units=16)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss_fn, 'sgd', {'learning_rate': 0.1},
+                            mesh=mesh,
+                            param_specs={'no_such_param': P('tp', None)})
+    x = nd.array(onp.random.randn(8, 16).astype(onp.float32))
+    y = nd.array(onp.random.randint(0, 8, 8).astype(onp.float32))
+    with pytest.warns(RuntimeWarning, match='matched no'):
+        step(x, y)
+    assert step.param_spec_report == {'no_such_param': []}

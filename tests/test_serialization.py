@@ -235,3 +235,31 @@ def test_imageiter_pad_wraps_with_real_samples(tmp_path):
         it.next()                            # epoch ends after the wrap
     it.reset()
     assert it.next().pad == 5                # iterable again after reset
+
+
+def test_legacy_v1_none_shape_reads_as_none():
+    """A V1 stream with ndim < 0 is a none-array, not a TypeError
+    (advisor r2; ref: LegacyLoad shape_is_none branch)."""
+    from mxnet_tpu.serialization import read_ndarray
+    v1 = io.BytesIO()
+    v1.write(struct.pack('<I', NDARRAY_V1_MAGIC))
+    v1.write(struct.pack('<i', -1))              # ndim < 0: unknown shape
+    v1.seek(0)
+    assert read_ndarray(v1) is None
+
+
+def test_sparse_none_storage_shape_raises_format_error():
+    """A sparse stream with unknown storage_shape is malformed: raise
+    FormatError instead of a TypeError downstream (advisor r2)."""
+    from mxnet_tpu.serialization import read_ndarray
+    buf = io.BytesIO()
+    buf.write(struct.pack('<I', NDARRAY_V2_MAGIC))
+    buf.write(struct.pack('<i', 1))              # row_sparse
+    buf.write(struct.pack('<i', -1))             # storage_shape: unknown
+    buf.write(struct.pack('<i', 2))              # shape ndim=2
+    buf.write(struct.pack('<2q', 4, 3))
+    buf.write(struct.pack('<ii', 1, 0))          # ctx
+    buf.write(struct.pack('<i', 0))              # f32
+    buf.seek(0)
+    with pytest.raises(FormatError):
+        read_ndarray(buf)
