@@ -107,6 +107,12 @@ def _as_key_padding_mask(mask, N, Tk):
 
 _pallas_fallback_warned = [False]
 
+# trace-time routing telemetry: [pallas_hits, xla_hits]. Incremented when
+# multi_head_attention picks a path (once per trace, not per step — jit
+# caches the traced program). Lets benches/tests assert the flagship
+# config really routes through the flash kernel.
+route_counts = {'pallas': 0, 'xla': 0}
+
 
 @_reg
 def multi_head_attention(query, key, value, mask=None, num_heads=1,
@@ -128,9 +134,11 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
     dropout_p: attention-probability dropout, applied after softmax (the
     standard transformer recipe), active in autograd training mode (same
     gate as the dropout op). The PRNG key comes from the framework key
-    provider unless dropout_key overrides it. Attention dropout routes
-    through the XLA path (the Pallas kernel never materialises the
-    probability matrix); set dropout_p=0 for the max-MFU configuration.
+    provider unless dropout_key overrides it. On the Pallas route the
+    dropout keep-mask is generated INSIDE the kernel (counter-based PRNG
+    seeded from the key), so the T×T probability matrix is never
+    materialised even in training; the flagship BERT config (dropout=0.1)
+    runs the flash kernel.
     """
     N, Tq, tot = query.shape
     H = num_heads
@@ -142,7 +150,7 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
     apply_dropout = dropout_p > 0.0 and (dropout_key is not None
                                          or _flags.is_training)
 
-    if use_pallas in ('auto', True) and not apply_dropout:
+    if use_pallas in ('auto', True):
         from .pallas_attention import flash_attention, pallas_available
         kpm = _as_key_padding_mask(mask, N, k.shape[2])
         if (use_pallas is True or pallas_available()) and \
@@ -151,7 +159,18 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
                                                       jnp.floating):
                 kpm = kpm.astype(jnp.bool_)  # truthy = keep
             try:
-                out = flash_attention(q, k, v, key_mask=kpm, causal=causal)
+                if apply_dropout:
+                    key_ = dropout_key if dropout_key is not None \
+                        else _random.next_key()
+                    seed = jax.random.bits(key_, (1, 1), jnp.uint32)
+                    out = flash_attention(q, k, v, key_mask=kpm,
+                                          causal=causal,
+                                          dropout_p=dropout_p,
+                                          dropout_seed=seed)
+                else:
+                    out = flash_attention(q, k, v, key_mask=kpm,
+                                          causal=causal)
+                route_counts['pallas'] += 1
                 return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
             except Exception:
                 if use_pallas is True:
@@ -164,6 +183,7 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
                         "back to the XLA attention path for this process.",
                         RuntimeWarning)
 
+    route_counts['xla'] += 1
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum('nhqd,nhkd->nhqk', q * scale, k,
                         preferred_element_type=jnp.float32)
