@@ -151,6 +151,7 @@ class Trainer:
             return
         import jax
         from ..kvstore.kvstore import _reduce
+        items = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
                 continue
@@ -160,13 +161,188 @@ class Trainer:
             # the reduction happens here so no context's contribution drops
             g = grads[0] if (self._kvstore is not None or len(grads) == 1) \
                 else _reduce(grads)
-            # update the first copy (optimizer state lives with it),
-            # broadcast to the rest (ref: trainer.py:430 per-device update;
-            # collapsed so state copies don't ping-pong between devices)
-            self._updater(i, g, datas[0])
+            items.append((i, param, g, datas))
+        # one jitted multi-tensor apply for ALL parameters (the analog of
+        # the reference's fused preloaded_multi_sgd/multi_lamb update ops,
+        # ref: src/operator/contrib/preloaded_multi_sgd.cc) — falls back to
+        # the per-param python loop for optimizers that sync to host
+        # mid-update (e.g. LARS norms)
+        if self._fused_apply(items):
+            pass
+        else:
+            for i, param, g, datas in items:
+                self._updater(i, g, datas[0])
+        # broadcast the updated first copy to the other context copies
+        # (ref: trainer.py:430 per-device update; collapsed so state
+        # copies don't ping-pong between devices)
+        for i, param, g, datas in items:
             src = datas[0]._data
             for d in datas[1:]:
                 d._data = jax.device_put(src, d._data.sharding)
+
+    def _fused_apply(self, items):
+        """Run every parameter update as ONE compiled XLA program.
+
+        The optimizer's python `update()` is traced once (per param-set /
+        dtype signature) with the per-step host scalars — lr, wd, update
+        count t, rescale_grad — fed in as traced inputs, so subsequent
+        steps re-run the cached program with zero python dispatch per
+        parameter. Optimizer state NDArrays are updated in place (their
+        `_data` is swapped), preserving save_states()/set_states().
+        Returns False when the optimizer cannot be traced (host syncs) —
+        caller falls back to the eager per-param loop."""
+        if not items:
+            return True
+        if getattr(self, '_fused_disabled', False):
+            return False
+        if not getattr(self._optimizer, 'fused_update', False):
+            # opt-in only: an impure update() (host syncs, python-state
+            # mutation) can trace "successfully" but compute the wrong
+            # schedule — never guess
+            self._fused_disabled = True
+            return False
+        if any(p._stype != 'default' or p._grad_stype != 'default'
+               for _, p, _, _ in items):
+            return False
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+
+        opt = self._optimizer
+        updater = self._updater
+        indices = [i for i, _, _, _ in items]
+        # materialize states eagerly (outside the trace)
+        for i, p, g, datas in items:
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(
+                    i, datas[0])
+                updater.states_synced[i] = True
+
+        def _flat(s, out):
+            if isinstance(s, NDArray):
+                out.append(s._data)
+            elif isinstance(s, (list, tuple)):
+                for x in s:
+                    _flat(x, out)
+            return out
+
+        def _reshape(s, leaves):
+            """Rebuild the state structure from flat leaves as NDArrays."""
+            if isinstance(s, NDArray):
+                return NDArray(leaves.pop(0))
+            if isinstance(s, (list, tuple)):
+                return tuple(_reshape(x, leaves) for x in s)
+            return s
+
+        sig = (tuple(indices), opt.__class__,
+               tuple(d._data.dtype.name for _, _, _, ds in items
+                     for d in ds[:1]))
+        cache = getattr(self, '_fused_cache', None)
+        if cache is None or cache[0] != sig:
+            structs = [updater.states[i] for i in indices]
+
+            # wds ride as a STATIC tuple: the ops branch on `if wd` with
+            # python control flow, so weight decay must be concrete at
+            # trace time (wd changes retrace — they only change via
+            # set_wd_mult, not per step). lr/t/rescale are traced.
+            def fused(weights, grads, states_flat, lrs, ts, rescale, wds):
+                leaves = list(states_flat)
+                saved_count = opt._index_update_count
+                saved_rescale = opt.rescale_grad
+                pos = {idx: n for n, idx in enumerate(indices)}
+                # shadow the scalar accessors on the INSTANCE with traced
+                # values for the duration of the trace; the class methods
+                # come back when the shadows are deleted (restoring bound
+                # methods would leave unpicklable attrs in __dict__,
+                # breaking save_states(dump_optimizer=True))
+                opt._get_lr = lambda idx: lrs[pos[idx]]
+                opt._get_wd = lambda idx: wds[pos[idx]]
+                opt._update_count = lambda idx: None
+                opt._index_update_count = \
+                    type('T', (), {'__getitem__':
+                                   staticmethod(lambda idx: ts[pos[idx]])})()
+                opt.rescale_grad = rescale
+                try:
+                    new_w, new_s = [], []
+                    for n, idx in enumerate(indices):
+                        w = NDArray(weights[n])
+                        g = NDArray(grads[n])
+                        st = _reshape(structs[n], leaves)
+                        opt.update_multi_precision(idx, w, g, st)
+                        new_w.append(w._data)
+                        new_s.extend(_flat(st, []))
+                finally:
+                    for name in ('_get_lr', '_get_wd', '_update_count'):
+                        opt.__dict__.pop(name, None)
+                    opt._index_update_count = saved_count
+                    opt.rescale_grad = saved_rescale
+                return new_w, new_s
+
+            jitted = jax.jit(fused, donate_argnums=(0, 2),
+                             static_argnums=(6,))
+            self._fused_cache = (sig, fused, jitted)
+            self._fused_traced = False
+        _, fused_fn, jitted = self._fused_cache
+
+        # host-side per-step scalars (counts first, as the reference does);
+        # snapshot them so a failed trace can roll back before the eager
+        # fallback re-counts
+        count_snapshot = (dict(opt._index_update_count), opt.num_update)
+        for i in indices:
+            opt._update_count(i)
+        lrs = jnp.asarray(opt._get_lrs(indices), jnp.float32)
+        wds = tuple(float(w) for w in opt._get_wds(indices))
+        ts = jnp.asarray([opt._index_update_count[i] for i in indices],
+                         jnp.float32)
+        rescale = jnp.asarray(opt.rescale_grad, jnp.float32)
+        weights = [datas[0]._data for _, _, _, datas in items]
+        grads = [g._data for _, _, g, _ in items]
+        states_flat = []
+        for i in indices:
+            _flat(updater.states[i], states_flat)
+        if not getattr(self, '_fused_traced', False):
+            # probe traceability ABSTRACTLY first: eval_shape consumes no
+            # buffers, so a trace failure here can still fall back to the
+            # eager loop with every weight/state intact. The real jitted
+            # call below donates its inputs — after it dispatches there is
+            # nothing to fall back TO, so its errors propagate.
+            try:
+                jax.eval_shape(lambda w, g, s, a, b, c: fused_fn(
+                    w, g, s, a, b, c, wds), weights, grads, states_flat,
+                    lrs, ts, rescale)
+                self._fused_traced = True
+            except Exception:
+                import os
+                if os.environ.get('MXNET_TPU_FUSED_DEBUG'):
+                    import traceback
+                    traceback.print_exc()
+                import warnings
+                warnings.warn(
+                    f"Trainer: {opt.__class__.__name__}.update() did not "
+                    f"trace; falling back to the eager per-parameter "
+                    f"update loop for this trainer.", RuntimeWarning)
+                # restore the update counts the eager path will re-apply
+                opt._index_update_count, opt.num_update = count_snapshot
+                self._fused_disabled = True
+                self._fused_cache = None
+                return False
+        new_w, new_s = jitted(weights, grads, states_flat, lrs,
+                              ts, rescale, wds)
+        for (_, _, _, datas), w in zip(items, new_w):
+            datas[0]._data = w
+        pos = 0
+
+        def _assign(s):
+            nonlocal pos
+            if isinstance(s, NDArray):
+                s._data = new_s[pos]
+                pos += 1
+            elif isinstance(s, (list, tuple)):
+                for x in s:
+                    _assign(x)
+        for i in indices:
+            _assign(updater.states[i])
+        return True
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

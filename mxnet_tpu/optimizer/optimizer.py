@@ -32,6 +32,14 @@ def create(name, **kwargs):
 class Optimizer:
     """Base optimizer (ref: optimizer.py:52)."""
 
+    # True when update() is pure jnp math over (weight, grad, state) plus
+    # the (lr, wd, t, rescale_grad) scalars — the Trainer then compiles
+    # ALL parameter updates into one jitted multi-tensor program (analog
+    # of ref src/operator/contrib/preloaded_multi_sgd.cc). Optimizers
+    # that sync to host (LARS), draw randomness (SGLD), or mutate python
+    # state mid-update (Nadam's m_schedule) must leave this False.
+    fused_update = False
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
@@ -168,6 +176,7 @@ def _cg(v):
 @register
 class SGD(Optimizer):
     """SGD with momentum and multi-precision (ref: optimizer.py:526)."""
+    fused_update = True
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
@@ -204,6 +213,7 @@ class SGD(Optimizer):
 @register
 class Signum(Optimizer):
     """Ref: optimizer.py:672."""
+    fused_update = True
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -235,6 +245,7 @@ class Signum(Optimizer):
 
 @register
 class FTML(Optimizer):
+    fused_update = True
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
         self.beta1 = beta1
@@ -300,6 +311,7 @@ class LARS(Optimizer):
 @register
 class LAMB(Optimizer):
     """Layer-wise Adaptive Moments for Batch training (ref: optimizer.py:1250)."""
+    fused_update = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
@@ -339,6 +351,7 @@ class LAMB(Optimizer):
 
 @register
 class NAG(Optimizer):
+    fused_update = True
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -386,6 +399,7 @@ class SGLD(Optimizer):
 @register
 class Adam(Optimizer):
     """Ref: optimizer.py:1547."""
+    fused_update = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
@@ -406,7 +420,9 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        # ** 0.5 (not math.sqrt): stays traceable when t rides
+        # through the Trainer's fused-update jit as a tracer
+        lr_t = lr * coef2 ** 0.5 / coef1
         mean, var = state
         lazy = self.lazy_update and grad.stype == 'row_sparse'
         new_w, new_mean, new_var = _invoke(
@@ -421,6 +437,7 @@ class Adam(Optimizer):
 @register
 class AdamW(Optimizer):
     """Decoupled weight decay Adam (ref: src/operator/contrib/adamw.cc)."""
+    fused_update = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, eta=1.0, **kwargs):
@@ -450,6 +467,7 @@ class AdamW(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    fused_update = True
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -472,6 +490,7 @@ class AdaGrad(Optimizer):
 
 @register
 class RMSProp(Optimizer):
+    fused_update = True
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -515,6 +534,7 @@ class RMSProp(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    fused_update = True
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -538,6 +558,7 @@ class AdaDelta(Optimizer):
 
 @register
 class Ftrl(Optimizer):
+    fused_update = True
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
@@ -562,6 +583,7 @@ class Ftrl(Optimizer):
 
 @register
 class Adamax(Optimizer):
+    fused_update = True
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1 = beta1
@@ -631,6 +653,7 @@ class Nadam(Optimizer):
 
 @register
 class DCASGD(Optimizer):
+    fused_update = True
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -662,6 +685,7 @@ class DCASGD(Optimizer):
 
 @register
 class Test(Optimizer):
+    fused_update = True
     def create_state(self, index, weight):
         return nd_zeros(weight.shape, dtype='float32')
 
