@@ -14,7 +14,7 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
-from .base import state
+from .base import state, MXNetError
 
 
 class TapeNode:
@@ -75,11 +75,27 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
     datas = tuple(t._data for t in tensor_inputs)
     recording = state.is_recording and any(t._in_graph for t in tensor_inputs)
 
-    if not recording:
-        return g(*datas), tensor_inputs, None, g
-
-    out_data, vjp_fn = jax.vjp(g, *datas)
-    return out_data, tensor_inputs, vjp_fn, g
+    try:
+        if not recording:
+            return g(*datas), tensor_inputs, None, g
+        out_data, vjp_fn = jax.vjp(g, *datas)
+        return out_data, tensor_inputs, vjp_fn, g
+    except MXNetError:
+        raise
+    except jax.errors.JAXTypeError:
+        # tracer-leak / concretization errors carry jax-specific remedies
+        # (and framework code dispatches on them, e.g. the trainer's
+        # fused-update probe) — pass them through untranslated
+        raise
+    except (TypeError, ValueError, ZeroDivisionError) as e:
+        # the reference surfaces op failures as MXNetError (engine
+        # on_complete callbacks, ref: src/engine/threaded_engine.cc
+        # ExecuteOprBlock exception capture); the imperative dispatch here
+        # is synchronous so the raise happens at invoke, not at
+        # wait_to_read — but the type and the recovered-engine behavior
+        # match (tests/test_exc_handling.py)
+        name = getattr(fn, '__name__', str(fn))
+        raise MXNetError(f"Error in operator {name}: {e}") from e
 
 
 def record_node(tensor_inputs, outputs, vjp_fn, fn=None, name="",

@@ -132,8 +132,37 @@ def _unwrap(x):
     return x
 
 
+from ..base import get_op as _get_op, list_ops as _list_ops  # noqa: E402
+
+_OP_SET = frozenset(_list_ops())
+
+# frontend names whose registered `_npi_*`/`_np_*` op has a DIFFERENT
+# calling convention than numpy's public function (value-dependent output
+# shapes, sequence-vs-varargs, alternate parameterisations) — these keep
+# the direct jnp lowering; the registered op remains the internal form.
+_KEEP_JNP = frozenset({
+    'where', 'insert', 'delete', 'unique', 'nonzero', 'bincount',
+    'percentile', 'quantile', 'tensordot', 'pad', 'linspace', 'einsum',
+    'split', 'hsplit', 'vsplit', 'dsplit', 'array_split', 'concatenate',
+    'stack', 'vstack', 'hstack', 'dstack', 'column_stack', 'meshgrid',
+    'atleast_1d', 'atleast_2d', 'atleast_3d',
+})
+
+
+def _resolve_op(fname):
+    """The registered numpy-namespace op backing a frontend function, when
+    its signature is numpy-compatible (ref: python/mxnet/numpy/multiarray.py
+    dispatching into the _npi_* C ops)."""
+    if fname in _KEEP_JNP:
+        return None
+    for cand in ('_npi_' + fname, '_np_' + fname):
+        if cand in _OP_SET:
+            return _get_op(cand).fn
+    return None
+
+
 def _make(fname):
-    jfn = getattr(jnp, fname)
+    jfn = _resolve_op(fname) or getattr(jnp, fname)
 
     def fn(*args, **kwargs):
         args = tuple(_unwrap(a) for a in args)
@@ -196,9 +225,21 @@ _FUNCS = [
     'shape', 'ndim', 'size', 'iterable', 'packbits', 'unpackbits',
 ]
 
+_FUNCS += ['any', 'all', 'matmul']
+
 for _f in _FUNCS:
-    if hasattr(jnp, _f):
+    if _resolve_op(_f) is not None or hasattr(jnp, _f):
         globals()[_f] = _make(_f)
+
+
+def einsum(subscripts, *operands, **kwargs):
+    """Dispatches through the registered _npi_einsum op
+    (ref: src/operator/numpy/np_einsum_op.cc)."""
+    ops = tuple(_unwrap(o) for o in operands)
+    out = _get_op('_npi_einsum').fn(
+        *ops, subscripts=subscripts,
+        optimize=bool(kwargs.get('optimize', False)))
+    return ndarray(out)
 
 
 def fix(x):
@@ -303,6 +344,59 @@ class random:
     def seed(s):
         _framework_random.seed(s)
 
+    # distribution samplers dispatch through the registered _npi_* ops
+    # (ref: src/operator/numpy/random/np_*_op.cc)
+    @staticmethod
+    def _sample(opname, *args, **kwargs):
+        kwargs.pop('ctx', None)
+        return ndarray(_get_op(opname).fn(
+            *[_unwrap(a) for a in args],
+            **{k: _unwrap(v) for k, v in kwargs.items()}))
+
+    @staticmethod
+    def gamma(shape=1.0, scale=1.0, size=None):
+        return random._sample('_npi_gamma', shape, scale, size=size)
+
+    @staticmethod
+    def exponential(scale=1.0, size=None):
+        return random._sample('_npi_exponential', scale, size=size)
+
+    @staticmethod
+    def gumbel(loc=0.0, scale=1.0, size=None):
+        return random._sample('_npi_gumbel', loc, scale, size=size)
+
+    @staticmethod
+    def logistic(loc=0.0, scale=1.0, size=None):
+        return random._sample('_npi_logistic', loc, scale, size=size)
+
+    @staticmethod
+    def laplace(loc=0.0, scale=1.0, size=None):
+        return random._sample('_npi_laplace', loc, scale, size=size)
+
+    @staticmethod
+    def rayleigh(scale=1.0, size=None):
+        return random._sample('_npi_rayleigh', scale, size=size)
+
+    @staticmethod
+    def weibull(a=1.0, size=None):
+        return random._sample('_npi_weibull', a, size=size)
+
+    @staticmethod
+    def pareto(a=1.0, size=None):
+        return random._sample('_npi_pareto', a, size=size)
+
+    @staticmethod
+    def power(a=1.0, size=None):
+        return random._sample('_npi_powerd', a, size=size)
+
+    @staticmethod
+    def bernoulli(prob=0.5, size=None):
+        return random._sample('_npi_bernoulli', prob, size=size)
+
+    @staticmethod
+    def multinomial(n, pvals, size=None):
+        return random._sample('_npi_multinomial', n, pvals, size=size)
+
 
 class linalg:
     @staticmethod
@@ -361,3 +455,35 @@ class linalg:
     @staticmethod
     def pinv(a):
         return ndarray(jnp.linalg.pinv(_unwrap(a)))
+
+    @staticmethod
+    def eig(a):
+        w, v = _get_op('_npi_eig').fn(_unwrap(a))
+        return ndarray(w), ndarray(v)
+
+    @staticmethod
+    def eigvals(a):
+        return ndarray(_get_op('_npi_eigvals').fn(_unwrap(a)))
+
+    @staticmethod
+    def eigvalsh(a, UPLO='L'):
+        return ndarray(_get_op('_npi_eigvalsh').fn(_unwrap(a),
+                                                   upper=UPLO == 'U'))
+
+    @staticmethod
+    def tensorinv(a, ind=2):
+        return ndarray(_get_op('_npi_tensorinv').fn(_unwrap(a), ind=ind))
+
+    @staticmethod
+    def tensorsolve(a, b, axes=None):
+        return ndarray(_get_op('_npi_tensorsolve').fn(
+            _unwrap(a), _unwrap(b), a_axes=axes))
+
+    @staticmethod
+    def multi_dot(arrays):
+        return ndarray(_get_op('_npi_multi_dot').fn(
+            *[_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def matrix_power(a, n):
+        return ndarray(_get_op('_npi_matrix_power').fn(_unwrap(a), n=n))

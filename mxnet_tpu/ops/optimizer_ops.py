@@ -258,3 +258,245 @@ def all_finite(*arrays):
     for a in arrays:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
     return ok.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused updates (ref: src/operator/optimizer_op.cc
+# multi_sgd_update family; src/operator/contrib/preloaded_multi_sgd.cc;
+# contrib/multi_lamb.cc; contrib/multi_lans.cc). The reference batches many
+# small parameter updates into one kernel launch; here one call produces a
+# single XLA program over every tensor — same dispatch-amortization, and
+# inside a jitted train step XLA fuses it with the backward pass.
+# ---------------------------------------------------------------------------
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+@_reg
+def multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """SGD over N tensors at once. weights/grads: lists; lrs/wds: per-tensor
+    scalars (ref: optimizer_op.cc multi_sgd_update)."""
+    weights, grads = _as_list(weights), _as_list(grads)
+    return [sgd_update(w, g, lr=lr, wd=wd, rescale_grad=rescale_grad,
+                       clip_gradient=clip_gradient)
+            for w, g, lr, wd in zip(weights, grads, lrs, wds)]
+
+
+@_reg
+def multi_sgd_mom_update(weights, grads, moms, lrs, wds, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    weights, grads, moms = _as_list(weights), _as_list(grads), _as_list(moms)
+    outs = [sgd_mom_update(w, g, m, lr=lr, momentum=momentum, wd=wd,
+                           rescale_grad=rescale_grad,
+                           clip_gradient=clip_gradient)
+            for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds)]
+    return [o[0] for o in outs], [o[1] for o in outs]
+
+
+@_reg
+def multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    weights, grads = _as_list(weights), _as_list(grads)
+    weights32 = _as_list(weights32)
+    outs = [mp_sgd_update(w, g, w32, lr=lr, wd=wd,
+                          rescale_grad=rescale_grad,
+                          clip_gradient=clip_gradient)
+            for w, g, w32, lr, wd in zip(weights, grads, weights32, lrs,
+                                         wds)]
+    return [o[0] for o in outs], [o[1] for o in outs]
+
+
+@_reg
+def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs, wds,
+                            momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    weights, grads = _as_list(weights), _as_list(grads)
+    moms, weights32 = _as_list(moms), _as_list(weights32)
+    outs = [mp_sgd_mom_update(w, g, m, w32, lr=lr, momentum=momentum,
+                              wd=wd, rescale_grad=rescale_grad,
+                              clip_gradient=clip_gradient)
+            for w, g, m, w32, lr, wd in zip(weights, grads, moms,
+                                            weights32, lrs, wds)]
+    return ([o[0] for o in outs], [o[1] for o in outs],
+            [o[2] for o in outs])
+
+
+def _grad_prep_preloaded(grad, rescale_grad, clip_gradient, wd, weight):
+    """_grad_prep for the preloaded_* contract: lr/wd are DEVICE tensors
+    (possibly traced), so the weight-decay add is unconditional — no
+    python control flow on wd (ref: contrib/preloaded_multi_sgd.cc, where
+    lrs/wds are kernel inputs, not attributes)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@_reg
+def preloaded_multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    """Like multi_sgd_update but lrs/wds arrive as device tensors (the
+    'preloaded' variant avoids host scalars entirely,
+    ref: contrib/preloaded_multi_sgd.cc); safe under jit."""
+    weights, grads = _as_list(weights), _as_list(grads)
+    new_w = []
+    for i, (w, g) in enumerate(zip(weights, grads)):
+        g32 = _grad_prep_preloaded(g, rescale_grad, clip_gradient, wds[i], w)
+        new_w.append((w.astype(jnp.float32) - lrs[i] * g32).astype(w.dtype))
+    return new_w
+
+
+@_reg
+def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds,
+                                   momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0):
+    weights, grads, moms = _as_list(weights), _as_list(grads), _as_list(moms)
+    new_w, new_m = [], []
+    for i, (w, g, m) in enumerate(zip(weights, grads, moms)):
+        g32 = _grad_prep_preloaded(g, rescale_grad, clip_gradient, wds[i], w)
+        nm = momentum * m - lrs[i] * g32
+        new_m.append(nm)
+        new_w.append((w.astype(jnp.float32) + nm).astype(w.dtype))
+    return new_w, new_m
+
+
+@_reg
+def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                                  rescale_grad=1.0, clip_gradient=-1.0):
+    weights, grads = _as_list(weights), _as_list(grads)
+    weights32 = _as_list(weights32)
+    new_w, new_w32 = [], []
+    for i, (w, g, w32) in enumerate(zip(weights, grads, weights32)):
+        g32 = _grad_prep_preloaded(g, rescale_grad, clip_gradient, wds[i],
+                                   w32)
+        nw32 = w32 - lrs[i] * g32
+        new_w32.append(nw32)
+        new_w.append(nw32.astype(w.dtype))
+    return new_w, new_w32
+
+
+@_reg
+def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32,
+                                      lrs, wds, momentum=0.0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    weights, grads = _as_list(weights), _as_list(grads)
+    moms, weights32 = _as_list(moms), _as_list(weights32)
+    new_w, new_m, new_w32 = [], [], []
+    for i, (w, g, m, w32) in enumerate(zip(weights, grads, moms,
+                                           weights32)):
+        g32 = _grad_prep_preloaded(g, rescale_grad, clip_gradient, wds[i],
+                                   w32)
+        nm = momentum * m - lrs[i] * g32
+        nw32 = w32 + nm
+        new_m.append(nm)
+        new_w32.append(nw32)
+        new_w.append(nw32.astype(w.dtype))
+    return new_w, new_m, new_w32
+
+
+def _lamb_one(w, g, m, v, lr, wd, beta1, beta2, epsilon, t, bias_correction,
+              rescale_grad, clip_gradient, lower_bound, upper_bound):
+    # one tensor of the multi-tensor op == phase1 + norms + phase2 (the
+    # same kernels the LAMB optimizer class uses — single source of truth)
+    update, m_new, v_new = lamb_update_phase1(
+        w, g, m, v, beta1=beta1, beta2=beta2, epsilon=epsilon, t=t,
+        bias_correction=bias_correction, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=-1.0 if clip_gradient is None else clip_gradient)
+    r1 = jnp.linalg.norm(w.astype(jnp.float32).reshape(-1))
+    r2 = jnp.linalg.norm(update.reshape(-1))
+    new_w = lamb_update_phase2(
+        w, update, r1, r2, lr=lr,
+        lower_bound=-1.0 if lower_bound is None else lower_bound,
+        upper_bound=-1.0 if upper_bound is None else upper_bound)
+    return new_w, m_new, v_new
+
+
+@_reg
+def multi_lamb_update(weights, grads, means, vars_, lrs, wds, step_count,
+                      beta1=0.9, beta2=0.999, epsilon=1e-6,
+                      bias_correction=True, rescale_grad=1.0,
+                      clip_gradient=-1.0, lower_bound=-1.0,
+                      upper_bound=-1.0):
+    """LAMB over N tensors (ref: contrib/multi_lamb.cc)."""
+    weights, grads = _as_list(weights), _as_list(grads)
+    means, vars_ = _as_list(means), _as_list(vars_)
+    outs = [_lamb_one(w, g, m, v, lrs[i], wds[i], beta1, beta2, epsilon,
+                      step_count[i], bias_correction, rescale_grad,
+                      None if clip_gradient is None or clip_gradient < 0
+                      else clip_gradient,
+                      None if lower_bound < 0 else lower_bound,
+                      None if upper_bound < 0 else upper_bound)
+            for i, (w, g, m, v) in enumerate(zip(weights, grads, means,
+                                                 vars_))]
+    return ([o[0] for o in outs], [o[1] for o in outs],
+            [o[2] for o in outs])
+
+
+def _lans_one(w, g, m, v, lr, wd, beta1, beta2, epsilon, t,
+              rescale_grad, clip_gradient):
+    g32 = _grad_prep(g, rescale_grad, clip_gradient)
+    g32 = g32 / jnp.maximum(jnp.linalg.norm(g32.reshape(-1)), 1e-12)
+    w32 = w.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * g32
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g32)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    r1 = jnp.linalg.norm(w32.reshape(-1))
+    upd_m = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w32
+    upd_g = g32 / (jnp.sqrt(vhat) + epsilon) + wd * w32
+    rm = jnp.linalg.norm(upd_m.reshape(-1))
+    rg = jnp.linalg.norm(upd_g.reshape(-1))
+    ratio_m = jnp.where((r1 > 0) & (rm > 0), r1 / rm, 1.0)
+    ratio_g = jnp.where((r1 > 0) & (rg > 0), r1 / rg, 1.0)
+    new_w = (w32 - lr * (beta1 * ratio_m * upd_m
+                         + (1 - beta1) * ratio_g * upd_g)).astype(w.dtype)
+    return new_w, m_new, v_new
+
+
+@_reg
+def multi_lans_update(weights, grads, means, vars_, lrs, wds, step_count,
+                      beta1=0.9, beta2=0.999, epsilon=1e-6,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    """LANS over N tensors (ref: contrib/multi_lans.cc)."""
+    weights, grads = _as_list(weights), _as_list(grads)
+    means, vars_ = _as_list(means), _as_list(vars_)
+    outs = [_lans_one(w, g, m, v, lrs[i], wds[i], beta1, beta2, epsilon,
+                      step_count[i], rescale_grad,
+                      None if clip_gradient is None or clip_gradient < 0
+                      else clip_gradient)
+            for i, (w, g, m, v) in enumerate(zip(weights, grads, means,
+                                                 vars_))]
+    return ([o[0] for o in outs], [o[1] for o in outs],
+            [o[2] for o in outs])
+
+
+@_reg
+def multi_adamw_update(weights, grads, means, vars_, rescale_grad, lrs,
+                       etas, wds, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                       clip_gradient=-1.0):
+    """AdamW over N tensors (ref: contrib/adamw.cc _multi_adamw_update).
+    rescale_grad arrives as a tensor; a non-finite value skips the update
+    (the reference's dynamic-loss-scale overflow protocol)."""
+    weights, grads = _as_list(weights), _as_list(grads)
+    means, vars_ = _as_list(means), _as_list(vars_)
+    scale = jnp.asarray(rescale_grad, jnp.float32).reshape(())
+    ok = jnp.isfinite(scale)
+    safe = jnp.where(ok, scale, 0.0)
+    new_ws, new_ms, new_vs = [], [], []
+    for i, (w, g, m, v) in enumerate(zip(weights, grads, means, vars_)):
+        g32 = g.astype(jnp.float32) * safe
+        if clip_gradient is not None and clip_gradient > 0:
+            g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * jnp.square(g32)
+        w32 = w.astype(jnp.float32)
+        upd = lrs[i] * (etas[i] * m_new / (jnp.sqrt(v_new) + epsilon)
+                        + wds[i] * w32)
+        new_w = (w32 - upd).astype(w.dtype)
+        new_ws.append(jnp.where(ok, new_w, w))
+        new_ms.append(jnp.where(ok, m_new, m))
+        new_vs.append(jnp.where(ok, v_new, v))
+    return new_ws, new_ms, new_vs

@@ -1,0 +1,65 @@
+"""Sparse storage ops.
+
+Ref: src/operator/tensor/cast_storage.cc, sparse_retain.cc,
+dot.cc (FComputeEx csr/row_sparse paths). The ndarray-level sparse API
+(ndarray/sparse.py) keeps a dense payload — XLA has no general sparse
+layout — so `cast_storage` is metadata at that level; the ops here supply
+the compute-side pieces: retain-by-rows, and a genuinely sparse
+matrix-multiply over jax.experimental.sparse BCOO for workloads where the
+operand is sparse enough that the BCOO contraction beats the dense MXU
+path (very high sparsity; on TPU the dense matmul usually wins, which is
+why the BCOO route is opt-in exactly like the reference's FComputeEx
+dispatch is storage-type driven).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+__all__ = []
+
+
+def _reg(fn):
+    register_op(fn.__name__)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_reg
+def cast_storage(data, stype='default'):
+    """Storage-type cast (ref: cast_storage.cc). The dense payload is the
+    canonical representation for every stype; values pass through
+    unchanged — the stype tag lives on the NDArray wrapper."""
+    return jnp.asarray(data)
+
+
+@_reg
+def sparse_retain(data, indices):
+    """Zero every row not named in `indices`
+    (ref: src/operator/tensor/sparse_retain.cc)."""
+    idx = jnp.asarray(indices, jnp.int32)
+    mask = jnp.zeros((data.shape[0],), bool).at[idx].set(True)
+    shape = (data.shape[0],) + (1,) * (data.ndim - 1)
+    return jnp.where(mask.reshape(shape), data, 0)
+
+
+@_reg
+def dot_csr_dense(lhs, rhs, nse=None):
+    """lhs @ rhs with lhs contracted through a BCOO sparse representation
+    (ref: dot.cc DotCsrDnsDnsImpl). `nse`: number of stored elements to
+    allocate (static under jit); defaults to the dense element count,
+    callers with known sparsity should pass the true nnz budget."""
+    from jax.experimental import sparse as jsparse
+    if nse is None:
+        nse = int(lhs.shape[0]) * int(lhs.shape[1])
+    sp = jsparse.BCOO.fromdense(lhs, nse=nse)
+    return sp @ rhs
+
+
+@_reg
+def storage_type(data):
+    """Always 'default' at the payload level (kDefaultStorage=0 in the
+    reference's stype enum); wrapper types carry csr/row_sparse tags."""
+    return jnp.zeros((), jnp.int32)

@@ -149,3 +149,432 @@ class EnvManager:
             os.environ[self._key] = self._prev_val
         elif self._key in os.environ:
             del os.environ[self._key]
+
+
+# ---------------------------------------------------------------------------
+# tolerance tiers, generators, comparison and measurement helpers
+# (ref: python/mxnet/test_utils.py get_atol/get_rtol/random_arrays/
+#  numeric_grad/check_symbolic_forward/compare_optimizer/...)
+# ---------------------------------------------------------------------------
+
+_RTOLS = {onp.dtype('float16'): 1e-2, onp.dtype('float32'): 1e-4,
+          onp.dtype('float64'): 1e-6}
+_ATOLS = {onp.dtype('float16'): 1e-2, onp.dtype('float32'): 1e-5,
+          onp.dtype('float64'): 1e-8}
+
+
+def _bf16_dtype():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def get_rtol(dtype=None, rtol=None):
+    """Per-dtype default relative tolerance; bf16 (the TPU compute dtype)
+    gets the loosest tier (8-bit mantissa ~= 2^-8)."""
+    if rtol is not None:
+        return rtol
+    if dtype is not None and onp.dtype(dtype).name == 'bfloat16':
+        return 2e-2
+    return _RTOLS.get(onp.dtype(dtype) if dtype is not None else
+                      onp.dtype('float32'), 1e-4)
+
+
+def get_atol(dtype=None, atol=None):
+    if atol is not None:
+        return atol
+    if dtype is not None and onp.dtype(dtype).name == 'bfloat16':
+        return 2e-2
+    return _ATOLS.get(onp.dtype(dtype) if dtype is not None else
+                      onp.dtype('float32'), 1e-5)
+
+
+def get_tolerance(arr, rtol=None, atol=None):
+    dt = getattr(arr, 'dtype', onp.float32)
+    return get_rtol(dt, rtol), get_atol(dt, atol)
+
+
+def random_arrays(*shapes):
+    """List of random float32 numpy arrays (scalars for () shapes)."""
+    arrays = [onp.random.randn(*s).astype(onp.float32) if s else
+              onp.float32(onp.random.randn()) for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+def random_uniform_arrays(*shapes, low=0.0, high=1.0, dtype='float32'):
+    return [onp.random.uniform(low, high, size=s).astype(dtype)
+            for s in shapes]
+
+
+def random_sample(population, k):
+    """Sample without replacement preserving population order."""
+    idx = sorted(onp.random.permutation(len(population))[:k].tolist())
+    return [population[i] for i in idx]
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    x = onp.random.randint(x_low, x_high)
+    y = onp.random.randint(y_low, y_high)
+    return x, y
+
+
+def create_2d_tensor(rows, columns, dtype=onp.int64):
+    return onp.arange(rows * columns, dtype=dtype).reshape(rows, columns)
+
+
+def create_vector(size, dtype=onp.int64):
+    return onp.arange(size, dtype=dtype)
+
+
+def assign_each(input_, fn):
+    return onp.vectorize(fn)(input_) if fn is not None else input_.copy()
+
+
+def assign_each2(input1, input2, fn):
+    return onp.vectorize(fn)(input1, input2) if fn is not None \
+        else input1.copy()
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reference-style reduce wrapper handling axis tuples + keepdims
+    (ref: test_utils.py np_reduce)."""
+    if isinstance(axis, int):
+        axis = (axis,)
+    axes = axis if axis is not None else tuple(range(dat.ndim))
+    ret = dat
+    for a in reversed(sorted(axes)):
+        ret = numpy_reduce_func(ret, axis=a)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for a in axes:
+            keepdims_shape[a] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=1e-5, atol=1e-8):
+    """Location and value of the worst |a-b| vs tolerance violation."""
+    a, b = _as_np(a), _as_np(b)
+    diff = onp.abs(a - b)
+    tol = atol + rtol * onp.abs(b)
+    violation = diff - tol
+    idx = onp.unravel_index(onp.argmax(violation), violation.shape) \
+        if violation.ndim else ()
+    return idx, float(diff[idx] if violation.ndim else diff)
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    assert_almost_equal(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal_with_err(a, b, rtol=1e-5, atol=1e-8, etol=0.0,
+                                 names=('a', 'b')):
+    """Allow a fraction etol of elements to violate tolerance
+    (ref: test_utils.py assert_almost_equal_with_err)."""
+    a, b = _as_np(a), _as_np(b)
+    bad = onp.abs(a - b) > atol + rtol * onp.abs(b)
+    frac = float(onp.mean(bad)) if bad.size else 0.0
+    if frac > etol:
+        idx, worst = find_max_violation(a, b, rtol, atol)
+        raise AssertionError(
+            f"{names[0]} != {names[1]}: {frac * 100:.2f}% elements exceed "
+            f"tol (allowed {etol * 100:.2f}%); worst at {idx}: {worst}")
+
+
+def almost_equal_ignore_nan(a, b, rtol=1e-5, atol=1e-8):
+    a, b = _as_np(a).copy(), _as_np(b).copy()
+    nan_mask = onp.logical_or(onp.isnan(a), onp.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return almost_equal(a, b, rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=1e-5, atol=1e-8,
+                                   names=('a', 'b')):
+    if not almost_equal_ignore_nan(a, b, rtol, atol):
+        raise AssertionError(f"{names[0]} != {names[1]} (ignoring NaN)")
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """f(*args, **kwargs) must raise exception_type
+    (ref: test_utils.py assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"did not raise {exception_type.__name__}")
+
+
+def retry(n):
+    """Retry a flaky (probabilistic) test up to n times (ref:
+    test_utils.py retry)."""
+    assert n > 0
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+            return None
+        return wrapper
+    return decorate
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Execute a symbol with numpy inputs, return numpy outputs
+    (ref: test_utils.py simple_forward)."""
+    inp = {k: array(v) for k, v in inputs.items()}
+    exe = sym.bind(ctx or default_context(), inp)
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def numeric_grad(f, inputs, eps=1e-4):
+    """Central finite differences of scalar-valued f at numpy inputs."""
+    base = [onp.asarray(a, onp.float64).copy() for a in inputs]
+    grads = []
+    for i, x in enumerate(base):
+        g = onp.zeros_like(x)
+        it = onp.nditer(x, flags=['multi_index'])
+        while not it.finished:
+            idx = it.multi_index
+            orig = x[idx]
+            x[idx] = orig + eps
+            fp = float(f(*base))
+            x[idx] = orig - eps
+            fm = float(f(*base))
+            x[idx] = orig
+            g[idx] = (fp - fm) / (2 * eps)
+            it.iternext()
+        grads.append(g)
+    return grads
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           ctx=None):
+    """Bind a symbol, run forward, compare each output against `expected`
+    (ref: test_utils.py check_symbolic_forward)."""
+    args = {k: array(v) for k, v in location.items()} \
+        if isinstance(location, dict) else \
+        {n: array(v) for n, v in zip(sym.list_arguments(), location)}
+    exe = sym.bind(ctx or default_context(), args)
+    outs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=1e-5, ctx=None):
+    """Bind with gradient buffers, run forward+backward, compare input
+    grads (ref: test_utils.py check_symbolic_backward)."""
+    names = sym.list_arguments()
+    loc = location if isinstance(location, dict) else \
+        dict(zip(names, location))
+    args = {k: array(v) for k, v in loc.items()}
+    grad_bufs = {k: array(onp.zeros_like(_as_np(v)))
+                 for k, v in args.items()}
+    exe = sym.bind(ctx or default_context(), args, args_grad=grad_bufs)
+    exe.forward(is_train=True)
+    exe.backward([array(g) for g in (
+        out_grads if isinstance(out_grads, (list, tuple)) else [out_grads])])
+    exp = expected if isinstance(expected, dict) else \
+        dict(zip(names, expected))
+    for k, e in exp.items():
+        assert_almost_equal(grad_bufs[k], e, rtol=rtol, atol=atol,
+                            names=(f'grad({k})', 'expected'))
+    return {k: v.asnumpy() for k, v in grad_bufs.items()}
+
+
+def check_speed(f, n=20, warmup=3):
+    """Median wall-clock seconds per call after warmup."""
+    import time
+    for _ in range(warmup):
+        f()
+    times = []
+    for _ in range(n):
+        t0 = time.time()
+        f()
+        times.append(time.time() - t0)
+    return float(onp.median(times))
+
+
+def same_array(a, b):
+    """True when two NDArrays share the same device buffer."""
+    da = a._data if isinstance(a, NDArray) else a
+    db = b._data if isinstance(b, NDArray) else b
+    return da is db
+
+
+class DummyIter:
+    """Repeats one batch forever (ref: test_utils.py DummyIter)."""
+
+    def __init__(self, batch):
+        self.batch = batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.batch
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a percent-point function (ref:
+    test_utils.py gen_buckets_probs_with_ppf)."""
+    probs = [1.0 / nbuckets] * nbuckets
+    buckets = [(ppf(i / nbuckets), ppf((i + 1) / nbuckets))
+               for i in range(nbuckets)]
+    return buckets, probs
+
+
+def mean_check(generator, mu, sigma, nsamples=1000000, nrepeat=5):
+    """Z-test that sample means are consistent with mu
+    (ref: test_utils.py mean_check)."""
+    ok = 0
+    for _ in range(nrepeat):
+        samples = onp.asarray(generator(nsamples), onp.float64)
+        z = (samples.mean() - mu) / (sigma / onp.sqrt(nsamples))
+        ok += abs(z) < 3.0
+    return ok >= nrepeat - 1
+
+
+def var_check(generator, sigma, nsamples=1000000, nrepeat=5):
+    ok = 0
+    for _ in range(nrepeat):
+        samples = onp.asarray(generator(nsamples), onp.float64)
+        ratio = samples.var() / (sigma ** 2)
+        ok += 0.9 < ratio < 1.1
+    return ok >= nrepeat - 1
+
+
+def verify_generator(generator, buckets, probs, nsamples=100000,
+                     nrepeat=3, success_rate=0.25):
+    """Chi-square bucket test for samplers (ref: test_utils.py
+    verify_generator / chi_square_check)."""
+    successes = 0
+    for _ in range(nrepeat):
+        samples = onp.asarray(generator(nsamples), onp.float64).ravel()
+        counts = onp.array(
+            [onp.sum((samples >= lo) & (samples < hi))
+             for lo, hi in buckets], onp.float64)
+        expected = onp.array(probs, onp.float64) * samples.size
+        chi2 = onp.sum((counts - expected) ** 2 / onp.maximum(expected, 1))
+        # dof = nbuckets-1; 99.9th percentile approx via Wilson-Hilferty
+        dof = len(buckets) - 1
+        crit = dof * (1 - 2 / (9 * dof) + 3.09 * onp.sqrt(2 / (9 * dof))) ** 3
+        successes += chi2 < crit
+    return successes >= max(1, int(nrepeat * success_rate))
+
+
+def compare_ndarray_tuple(t1, t2, rtol=1e-5, atol=1e-8):
+    """Elementwise compare (nested) tuples of NDArrays (ref: test_utils.py
+    compare_ndarray_tuple)."""
+    if t1 is None or t2 is None:
+        return
+    if isinstance(t1, tuple):
+        for a, b in zip(t1, t2):
+            compare_ndarray_tuple(a, b, rtol, atol)
+    else:
+        assert_almost_equal(t1, t2, rtol=rtol, atol=atol)
+
+
+def compare_optimizer(opt1, opt2, shapes, dtype, w_stype='default',
+                      g_stype='default', rtol=1e-4, atol=1e-5, ntrials=3):
+    """Run two optimizer implementations over identical weight/grad
+    streams and require identical trajectories + states (ref:
+    test_utils.py compare_optimizer)."""
+    from .ndarray import zeros
+    for _ in range(ntrials):
+        w1, w2, g1, g2, s1, s2 = [], [], [], [], [], []
+        for i, shape in enumerate(shapes):
+            w = onp.random.uniform(-1, 1, shape).astype(dtype)
+            g = onp.random.uniform(-1, 1, shape).astype(dtype)
+            w1.append(array(w)); w2.append(array(w.copy()))
+            g1.append(array(g)); g2.append(array(g.copy()))
+            s1.append(opt1.create_state_multi_precision(i, w1[-1]))
+            s2.append(opt2.create_state_multi_precision(i, w2[-1]))
+        for i in range(len(shapes)):
+            opt1.update_multi_precision(i, w1[i], g1[i], s1[i])
+            opt2.update_multi_precision(i, w2[i], g2[i], s2[i])
+            compare_ndarray_tuple(tuple(s1[i]) if isinstance(s1[i], tuple)
+                                  else (s1[i],) if s1[i] is not None else (),
+                                  tuple(s2[i]) if isinstance(s2[i], tuple)
+                                  else (s2[i],) if s2[i] is not None else (),
+                                  rtol, atol)
+            assert_almost_equal(w1[i], w2[i], rtol=rtol, atol=atol)
+
+
+def collapse_sum_like(a, shape):
+    """Sum-reduce `a` down to `shape` following broadcast rules (ref:
+    test_utils.py collapse_sum_like)."""
+    a = _as_np(a)
+    assert len(a.shape) >= len(shape)
+    if onp.prod(shape) == 0 or a.size == 0:
+        return onp.zeros(shape, a.dtype)
+    axes = list(range(len(a.shape) - len(shape)))
+    for i, s in enumerate(shape):
+        if s != a.shape[len(a.shape) - len(shape) + i]:
+            assert s == 1
+            axes.append(len(a.shape) - len(shape) + i)
+    return a.sum(axis=tuple(axes), keepdims=True).reshape(shape) \
+        if axes else a.reshape(shape)
+
+
+def check_gluon_hybridize_consistency(net_builder, data_l, numpy_func=None,
+                                      test_grad=True, rtol=1e-4, atol=1e-5):
+    """Eager vs hybridized forward (and backward) parity for a Gluon block
+    (ref: test_utils.py check_gluon_hybridize_consistency)."""
+    saved_out_np = None
+    saved_grad_np_l = None
+    for hybridize in (False, True):
+        net = net_builder()
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        in_data_l = [array(_as_np(x)) for x in data_l]
+        if test_grad:
+            for x in in_data_l:
+                x.attach_grad()
+            with autograd.record():
+                out = net(*in_data_l)
+            out.backward()
+            grad_np_l = [x.grad.asnumpy() for x in in_data_l]
+        else:
+            out = net(*in_data_l)
+            grad_np_l = None
+        out_np = out.asnumpy()
+        if saved_out_np is None:
+            saved_out_np = out_np
+            saved_grad_np_l = grad_np_l
+        else:
+            assert_almost_equal(out_np, saved_out_np, rtol=rtol, atol=atol)
+            if test_grad:
+                for g, sg in zip(grad_np_l, saved_grad_np_l):
+                    assert_almost_equal(g, sg, rtol=rtol, atol=atol)
+    if numpy_func is not None:
+        assert_almost_equal(saved_out_np,
+                            numpy_func(*[_as_np(x) for x in data_l]),
+                            rtol=rtol, atol=atol)
+
+
+def new_sym_matrix_with_real_eigvals_nd(n):
+    """Random symmetric matrix batch with real eigenvalues (ref:
+    test_utils.py new_sym_matrix_with_real_eigvals_nd)."""
+    a = onp.random.randn(n, n).astype(onp.float32)
+    return (a + a.T) / 2
+
+
+def new_matrix_with_real_eigvals_2d(n):
+    """Random matrix with real eigenvalues: D + small symmetric noise via
+    similarity transform (ref: test_utils.py)."""
+    d = onp.diag(onp.random.uniform(1.0, 2.0, n))
+    q, _ = onp.linalg.qr(onp.random.randn(n, n))
+    return (q @ d @ q.T).astype(onp.float32)

@@ -1,0 +1,88 @@
+"""Exception propagation (ref: tests/python/unittest/test_exc_handling.py).
+
+The reference's threaded engine captures op exceptions and rethrows them
+at synchronization points (WaitToRead / asnumpy), and the engine must stay
+usable afterwards. Here dispatch is synchronous python + async XLA, so op
+errors surface at invoke time as MXNetError — the same exception type —
+and the invariants tested are the same: typed errors, a usable engine
+after failure, propagation through autograd, hybridized blocks, and the
+compiled train step.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+
+def test_imperative_op_exception():
+    with pytest.raises(MXNetError) as exc:
+        nd.dot(nd.ones((2, 3)), nd.ones((4, 5)))
+    assert 'dot' in str(exc.value)
+
+
+def test_engine_usable_after_exception():
+    for _ in range(3):
+        with pytest.raises(MXNetError):
+            nd.dot(nd.ones((2, 3)), nd.ones((4, 5)))
+        out = (nd.ones((2, 2)) * 3).asnumpy()
+        assert out.sum() == 12.0
+
+
+def test_exception_inside_autograd():
+    x = nd.ones((2, 3))
+    x.attach_grad()
+    with pytest.raises(MXNetError):
+        with autograd.record():
+            y = nd.dot(x, nd.ones((4, 5)))
+    # the tape is not poisoned: a fresh record/backward works
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_exception_in_hybridized_block():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=8))
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 8)))                      # compile the good shape
+    with pytest.raises(Exception):
+        net(nd.ones((2, 5)))                  # in_units mismatch
+    out = net(nd.ones((3, 8)))                # still usable, new batch size
+    assert out.shape == (3, 4)
+
+
+def test_constraint_check_raises_with_message():
+    from mxnet_tpu.base import get_op
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match='positive'):
+        get_op('_npi_constraint_check').fn(
+            jnp.asarray([True, False]), 'must be positive')
+
+
+def test_exception_from_compiled_train_step():
+    """A label/batch mismatch inside the one-pjit train step surfaces as a
+    python exception and the step object remains usable."""
+    from mxnet_tpu.models import BertForPretraining, bert_pretrain_loss
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+    cfg = dict(vocab_size=64, hidden=16, layers=1, heads=2,
+               intermediate=32, max_len=16, type_vocab=2, dropout=0.0)
+    mx.random.seed(0)
+    model = BertForPretraining(cfg)
+    model.initialize(mx.init.Normal(0.02))
+    step = ShardedTrainStep(model, bert_pretrain_loss, 'sgd',
+                            {'learning_rate': 0.1},
+                            mesh=make_mesh((1,), ('dp',)))
+    rng = onp.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, 64, (2, 8)).astype(onp.int32))
+    types = nd.array(onp.zeros((2, 8), onp.int32))
+    good_labels = nd.array(rng.randint(0, 64, (2, 8)).astype(onp.int32))
+    nsp = nd.array(rng.randint(0, 2, (2,)).astype(onp.int32))
+    bad_labels = nd.array(rng.randint(0, 64, (3, 8)).astype(onp.int32))
+    with pytest.raises(Exception):
+        step([tokens, types], [bad_labels, nsp])
+    loss = step([tokens, types], [good_labels, nsp])
+    assert onp.isfinite(float(loss.asscalar()))

@@ -254,7 +254,7 @@ def test_trainer_fused_update_matches_eager():
             f"{optname} fell back to the eager loop"
         assert err < 1e-5, (optname, err)
         # one compiled program, reused every step (no per-step retrace)
-        jitted = tr_f._fused_cache[1]
+        jitted = tr_f._fused_cache[2]
         if hasattr(jitted, '_cache_size'):
             assert jitted._cache_size() == 1, jitted._cache_size()
 
