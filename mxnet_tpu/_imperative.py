@@ -14,7 +14,7 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
-from .base import state, MXNetError
+from .base import state, MXNetError, prof_flags
 
 
 class TapeNode:
@@ -76,6 +76,8 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
     recording = state.is_recording and any(t._in_graph for t in tensor_inputs)
 
     try:
+        if prof_flags['op']:
+            return _invoke_profiled(fn, g, datas, tensor_inputs, recording)
         if not recording:
             return g(*datas), tensor_inputs, None, g
         out_data, vjp_fn = jax.vjp(g, *datas)
@@ -96,6 +98,26 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
         # match (tests/test_exc_handling.py)
         name = getattr(fn, '__name__', str(fn))
         raise MXNetError(f"Error in operator {name}: {e}") from e
+
+
+def _invoke_profiled(fn, g, datas, tensor_inputs, recording):
+    """invoke() with per-op timing rows (ref: the reference wraps every
+    engine push in a profiler entry, src/profiler/profiler.h:299
+    PROFILER_MESSAGE). Timing covers dispatch; with profile_sync (or
+    aggregate_stats) the op is blocked to completion first, giving true
+    device time at the cost of pipelining."""
+    import time as _time
+    from . import profiler as _profiler
+    t0 = _time.perf_counter()
+    if not recording:
+        out, vjp_fn = g(*datas), None
+    else:
+        out, vjp_fn = jax.vjp(g, *datas)
+    if prof_flags['sync']:
+        jax.block_until_ready(out)
+    dur_us = (_time.perf_counter() - t0) * 1e6
+    _profiler.record_op(getattr(fn, '__name__', str(fn)), dur_us)
+    return out, tensor_inputs, vjp_fn, g
 
 
 def record_node(tensor_inputs, outputs, vjp_fn, fn=None, name="",

@@ -111,3 +111,9 @@ class _ThreadLocalState(threading.local):
 
 
 state = _ThreadLocalState()
+
+# PROCESS-wide profiling flags (plain dict, shared across threads — the
+# profiler's start/stop must affect worker threads too, unlike the
+# autograd flags above which are deliberately thread-local). Written by
+# profiler._sync_flags(), read by _imperative.invoke.
+prof_flags = {'op': False, 'sync': False}

@@ -262,10 +262,21 @@ class HybridBlock(Block):
         self._active = False
         self._cached_op = None
         self._flags = {}
+        self._subgraph_backend = None
 
     def hybridize(self, active=True, backend=None, clear=True, **kwargs):
-        """Ref: block.py:1043. backend hook unused: XLA is the backend."""
+        """Ref: block.py:1043. `backend` names a registered subgraph
+        partitioner (mxnet_tpu.subgraph) that pattern-matches the traced
+        graph and swaps matched regions for fused kernels — the analog of
+        the reference's SubgraphProperty backends
+        (src/operator/subgraph/subgraph_property.h:252). None keeps the
+        plain XLA compilation path."""
         self._active = active
+        if backend is not None:
+            from .. import subgraph as _subgraph
+            self._subgraph_backend = _subgraph.get_backend(backend)
+        elif clear:
+            self._subgraph_backend = None
         self._flags.update(kwargs)
         if clear:
             self._cached_op = None
@@ -387,7 +398,9 @@ class HybridBlock(Block):
         return sym_file, fname
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
-        self.hybridize(True)
+        """Partition for `backend` and build the cached op in one step
+        (ref: block.py optimize_for)."""
+        self.hybridize(True, backend=backend, **kwargs)
         return self(x, *args)
 
 
@@ -521,6 +534,9 @@ class CachedOp:
             aux_names_holder.extend(aux_names)
             return out_datas, aux
 
+        backend = getattr(self.block, '_subgraph_backend', None)
+        if backend is not None:
+            fn = backend.rewrite(fn)
         jitted = jax.jit(fn)
         # trace once now to discover aux names (jit caches the trace)
         ctx = None

@@ -38,4 +38,10 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    return AlexNet(**kwargs)
+    net = AlexNet(**kwargs)
+    if pretrained:
+        _load_pretrained(net, 'alexnet', root, ctx)
+    return net
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -82,7 +82,10 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        _load_pretrained(net, 'densenet%d' % num_layers, root, ctx)
+    return net
 
 
 def densenet121(**kwargs):
@@ -99,3 +102,6 @@ def densenet169(**kwargs):
 
 def densenet201(**kwargs):
     return get_densenet(201, **kwargs)
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -157,4 +157,10 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
-    return Inception3(**kwargs)
+    net = Inception3(**kwargs)
+    if pretrained:
+        _load_pretrained(net, 'inceptionv3', root, ctx)
+    return net
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -107,12 +107,24 @@ class MobileNetV2(HybridBlock):
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
-    return MobileNet(multiplier, **kwargs)
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        version_suffix = '{0:.2f}'.format(multiplier)
+        if version_suffix in ('1.00', '0.50'):
+            version_suffix = version_suffix[:-1]
+        _load_pretrained(net, 'mobilenet' + version_suffix, root, ctx)
+    return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
-    return MobileNetV2(multiplier, **kwargs)
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        version_suffix = '{0:.2f}'.format(multiplier)
+        if version_suffix in ('1.00', '0.50'):
+            version_suffix = version_suffix[:-1]
+        _load_pretrained(net, 'mobilenetv2_' + version_suffix, root, ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):
@@ -145,3 +157,6 @@ def mobilenet_v2_0_5(**kwargs):
 
 def mobilenet_v2_0_25(**kwargs):
     return get_mobilenet_v2(0.25, **kwargs)
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -229,12 +229,13 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     if num_layers not in resnet_spec:
         raise MXNetError(f"unsupported num_layers {num_layers}")
     block_type, layers, channels = resnet_spec[num_layers]
-    if pretrained:
-        raise MXNetError("pretrained weights unavailable (no egress); "
-                         "load_parameters from a local file instead")
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        _load_pretrained(net, 'resnet%d_v%d' % (num_layers, version),
+                         root, ctx)
+    return net
 
 
 def resnet18_v1(**kwargs):
@@ -275,3 +276,6 @@ def resnet101_v2(**kwargs):
 
 def resnet152_v2(**kwargs):
     return get_resnet(2, 152, **kwargs)
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

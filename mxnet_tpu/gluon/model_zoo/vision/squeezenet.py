@@ -76,7 +76,10 @@ class SqueezeNet(HybridBlock):
 
 
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
-    return SqueezeNet(version, **kwargs)
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        _load_pretrained(net, 'squeezenet' + version, root, ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):
@@ -85,3 +88,6 @@ def squeezenet1_0(**kwargs):
 
 def squeezenet1_1(**kwargs):
     return get_squeezenet('1.1', **kwargs)
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -50,7 +50,13 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        batch_norm = kwargs.get('batch_norm', False)
+        _load_pretrained(
+            net, 'vgg%d%s' % (num_layers, '_bn' if batch_norm else ''),
+            root, ctx)
+    return net
 
 
 def vgg11(**kwargs):
@@ -87,3 +93,6 @@ def vgg16_bn(**kwargs):
 def vgg19_bn(**kwargs):
     kwargs['batch_norm'] = True
     return get_vgg(19, **kwargs)
+
+
+from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

@@ -30,6 +30,11 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     return tn
 
 
+def replace_file(src, dst):
+    """Atomically move src over dst (ref: gluon/utils.py replace_file)."""
+    os.replace(src, dst)
+
+
 def check_sha1(filename, sha1_hash):
     sha1 = hashlib.sha1()
     with open(filename, 'rb') as f:

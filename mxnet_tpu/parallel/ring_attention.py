@@ -74,15 +74,21 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
         q_off = idx * Tl
 
         acc_out = jnp.zeros(q_blk.shape, jnp.float32)
-        acc_m = jnp.full(q_blk.shape[:3] + (1,), -jnp.inf, jnp.float32)
+        # -1e30 (not -inf): the first merge computes exp(acc_m - new_m),
+        # and inf - inf poisons reverse-mode AD with NaN cotangents
+        acc_m = jnp.full(q_blk.shape[:3] + (1,), -1e30, jnp.float32)
         acc_l = jnp.zeros(q_blk.shape[:3] + (1,), jnp.float32)
         # initial accumulators are constants; mark them as varying over the
         # ring axis so the scan carry type matches the per-shard outputs
-        acc_out, acc_m, acc_l = lax.pcast((acc_out, acc_m, acc_l), sp_axis, to='varying')
+        acc_out, acc_m, acc_l = lax.pcast((acc_out, acc_m, acc_l), sp_axis,
+                                          to='varying')
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
-        def body(i, carry):
+        def body(carry, i):
+            # lax.scan (not fori_loop): the ring loop must be
+            # reverse-differentiable — jax transposes the ppermute into
+            # the counter-rotating ring of the backward pass
             acc_out, acc_m, acc_l, k_cur, v_cur = carry
             # block currently held came from device (idx - i) mod n
             kv_off = ((idx - i) % n) * Tl
@@ -93,10 +99,10 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
             # rotate K/V around the ring (ICI neighbor exchange)
             k_next = lax.ppermute(k_cur, sp_axis, perm)
             v_next = lax.ppermute(v_cur, sp_axis, perm)
-            return acc_out, acc_m, acc_l, k_next, v_next
+            return (acc_out, acc_m, acc_l, k_next, v_next), None
 
-        acc_out, acc_m, acc_l, _, _ = lax.fori_loop(
-            0, n, body, (acc_out, acc_m, acc_l, k_blk, v_blk))
+        (acc_out, acc_m, acc_l, _, _), _ = lax.scan(
+            body, (acc_out, acc_m, acc_l, k_blk, v_blk), jnp.arange(n))
         return (acc_out / jnp.maximum(acc_l, 1e-30)).astype(q_blk.dtype)
 
     return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),

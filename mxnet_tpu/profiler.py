@@ -15,7 +15,7 @@ import time
 
 import jax
 
-from .base import MXNetError
+from .base import MXNetError, prof_flags as _prof_flags
 
 _config = {
     'filename': 'profile.json',
@@ -26,16 +26,74 @@ _config = {
     'profile_api': False,
     'aggregate_stats': False,
     'continuous_dump': False,
+    # block each profiled op to completion before timing it: true device
+    # time instead of dispatch time, at the cost of pipelining
+    'profile_sync': False,
+    # directory for the jax/XLA device trace started by start(); replaces
+    # the old MXNET_TPU_JAX_TRACE_DIR env-only path (still honored)
+    'jax_trace_dir': None,
 }
 _state = {'running': False, 'jax_trace_dir': None}
 _events = []
 _events_lock = threading.Lock()
+# op name -> [count, total_us, min_us, max_us] (aggregate_stats)
+_op_stats = {}
+
+
+def record_op(name, dur_us):
+    """One per-op profiler row (called from _imperative.invoke when
+    profile_imperative/profile_all is active)."""
+    now = time.time() * 1e6
+    ev = {'name': name, 'cat': 'operator', 'ph': 'X',
+          'ts': now - dur_us, 'dur': dur_us,
+          'pid': os.getpid(), 'tid': threading.get_ident()}
+    with _events_lock:
+        _events.append(ev)
+        st = _op_stats.get(name)
+        if st is None:
+            _op_stats[name] = [1, dur_us, dur_us, dur_us]
+        else:
+            st[0] += 1
+            st[1] += dur_us
+            st[2] = min(st[2], dur_us)
+            st[3] = max(st[3], dur_us)
+
+
+def get_summary(reset=False):
+    """Aggregate per-op table (ref: profiler.py dumps(aggregate_stats)):
+    name, calls, total/min/max/avg in ms."""
+    with _events_lock:
+        rows = sorted(_op_stats.items(), key=lambda kv: -kv[1][1])
+        if reset:
+            _op_stats.clear()
+    lines = [f"{'Name':<40s}{'Total Count':>12s}{'Time (ms)':>12s}"
+             f"{'Min (ms)':>12s}{'Max (ms)':>12s}{'Avg (ms)':>12s}"]
+    for name, (cnt, tot, mn, mx) in rows:
+        lines.append(f"{name[:39]:<40s}{cnt:>12d}{tot / 1e3:>12.4f}"
+                     f"{mn / 1e3:>12.4f}{mx / 1e3:>12.4f}"
+                     f"{tot / cnt / 1e3:>12.4f}")
+    return '\n'.join(lines)
 
 
 def set_config(**kwargs):
-    """Ref: python/mxnet/profiler.py set_config."""
-    for k, v in kwargs.items():
-        _config[k] = v
+    """Ref: python/mxnet/profiler.py set_config. profile_imperative /
+    profile_all turn on per-op rows (one entry per imperative op dispatch,
+    the analog of the reference wrapping engine pushes,
+    src/profiler/profiler.h:299); takes effect immediately if the
+    profiler is already running."""
+    unknown = [k for k in kwargs if k not in _config]
+    if unknown:
+        raise MXNetError(
+            f"profiler.set_config: unknown keys {unknown!r}")
+    _config.update(kwargs)
+    _sync_flags()
+
+
+def _sync_flags():
+    _prof_flags['op'] = bool(_state['running'] and (
+        _config['profile_imperative'] or _config['profile_all']))
+    _prof_flags['sync'] = bool(_config['profile_sync']
+                               or _config['aggregate_stats'])
 
 
 def profiler_set_config(mode='symbolic', filename='profile.json'):
@@ -52,7 +110,11 @@ def set_state(state='stop', profile_process='worker'):
 def start(profile_process='worker'):
     _state['running'] = True
     _events.clear()
-    tdir = os.environ.get('MXNET_TPU_JAX_TRACE_DIR')
+    with _events_lock:
+        _op_stats.clear()
+    _sync_flags()
+    tdir = _config['jax_trace_dir'] or \
+        os.environ.get('MXNET_TPU_JAX_TRACE_DIR')
     if tdir:
         jax.profiler.start_trace(tdir)
         _state['jax_trace_dir'] = tdir
@@ -60,6 +122,7 @@ def start(profile_process='worker'):
 
 def stop(profile_process='worker'):
     _state['running'] = False
+    _sync_flags()
     if _state['jax_trace_dir']:
         jax.profiler.stop_trace()
         _state['jax_trace_dir'] = None
@@ -67,10 +130,12 @@ def stop(profile_process='worker'):
 
 def pause(profile_process='worker'):
     _state['running'] = False
+    _sync_flags()
 
 
 def resume(profile_process='worker'):
     _state['running'] = True
+    _sync_flags()
 
 
 def dump(finished=True, profile_process='worker'):
@@ -81,11 +146,21 @@ def dump(finished=True, profile_process='worker'):
         json.dump(trace, f)
 
 
-def dumps(reset=False):
+def dumps(reset=False, format='table'):
+    """Aggregate-stats table when aggregate_stats is configured (the
+    reference's dumps contract, python/mxnet/profiler.py:dumps), else the
+    chrome-trace JSON of collected events (incl. per-op rows)."""
+    if _config['aggregate_stats'] and format == 'table':
+        out = get_summary(reset=reset)
+        if reset:
+            with _events_lock:
+                _events.clear()
+        return out
     with _events_lock:
         out = json.dumps({'traceEvents': list(_events)})
         if reset:
             _events.clear()
+            _op_stats.clear()
     return out
 
 

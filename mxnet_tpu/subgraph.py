@@ -1,0 +1,419 @@
+"""Subgraph partitioning backends (ref: src/operator/subgraph/
+subgraph_property.h:86,252 + partitioner registry in subgraph/
+build_subgraph.cc).
+
+The reference lets an accelerator backend pattern-match regions of the
+operator graph and swap them for fused super-ops at `hybridize(backend=)`
+time. The TPU-native analog operates on the traced jaxpr: a registered
+`SubgraphBackend.rewrite(fn)` wraps the function CachedOp compiles, makes
+its jaxpr, scans the equation list for known patterns, and re-evaluates
+the program with matched segments replaced by fused kernels.
+
+One production backend ships: `fuse_attention`, which recognises the
+naive attention lowering — dot_general(QK^T) → elementwise scale/mask
+chain → softmax (reduce_max/sub/exp/reduce_sum/div) → dot_general(AV) —
+and substitutes the Pallas flash-attention kernel
+(ops/pallas_attention.py), eliminating the materialised T×T probability
+tensor from any model that wrote its attention by hand.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, Registry
+
+__all__ = ['SubgraphBackend', 'register_backend', 'get_backend',
+           'list_backends', 'FuseAttentionBackend']
+
+_backends = Registry('subgraph_backend')
+
+
+class SubgraphBackend:
+    """A graph partitioner (ref: SubgraphProperty). Subclasses override
+    `rewrite(fn) -> fn`, returning a function with identical semantics
+    whose implementation may route matched subgraphs through fused
+    kernels. `stats` accumulates match counts for tests/diagnostics."""
+
+    name = 'base'
+
+    def __init__(self):
+        self.stats = {'matches': 0}
+
+    def rewrite(self, fn):
+        return fn
+
+
+def register_backend(cls):
+    _backends.register(cls, name=cls.name)
+    return cls
+
+
+def get_backend(name):
+    try:
+        backend = _backends.get(name)
+    except Exception:
+        raise MXNetError(
+            f"subgraph backend {name!r} is not registered; "
+            f"available: {list_backends()}") from None
+    return backend() if isinstance(backend, type) else backend
+
+
+def list_backends():
+    return _backends.list()
+
+
+# ---------------------------------------------------------------------------
+# jaxpr scanning helpers
+# ---------------------------------------------------------------------------
+
+def _is_lit(v):
+    return hasattr(v, 'val')
+
+
+def _scalar_lit(v):
+    """Float value of a scalar literal var, else None."""
+    if _is_lit(v) and getattr(v.val, 'shape', ()) == ():
+        try:
+            return float(v.val)
+        except Exception:
+            return None
+    return None
+
+
+class _AttnMatch:
+    __slots__ = ('dg1', 'dg2', 'skip', 'q', 'k', 'v', 'scale',
+                 'add_mask', 'add_mask_scale', 'sel_mask', 'out_var',
+                 'k_transposed')
+
+    def __init__(self):
+        self.skip = set()
+        self.scale = 1.0
+        self.add_mask = None
+        self.add_mask_scale = 1.0
+        self.sel_mask = None
+        self.k_transposed = False
+
+
+def _key_mask_shape(aval, scores_shape):
+    """True when `aval` broadcasts over scores (B,H,Tq,Tk) purely along
+    the key axis — i.e. reshapeable to (B, Tk)."""
+    s = tuple(aval.shape)
+    B, H, Tq, Tk = scores_shape
+    if len(s) != 4 or s[3] != Tk:
+        return False
+    return s[0] in (1, B) and s[1] == 1 and s[2] == 1
+
+
+def _find_attention(jaxpr):
+    """All fusable naive-attention segments in `jaxpr`.
+
+    Matches: dg2 = dot_general(softmax_out_or_convert, V) where the
+    softmax chain is div(exp_t, bcast(reduce_sum(exp_t))) with
+    exp_t = exp(sub(scores', bcast(stop_grad(max(reduce_max(scores'))))))
+    and scores' reaches a QK^T dot_general through an elementwise chain of
+    scalar mul/div, additive key-mask add, or select_n key-masking.
+    """
+    producer = {}
+    consumers = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for o in eqn.outvars:
+            producer[o] = (i, eqn)
+        for v in eqn.invars:
+            if not _is_lit(v):
+                consumers.setdefault(v, []).append(i)
+    for v in jaxpr.outvars:
+        if not _is_lit(v):
+            consumers.setdefault(v, []).append(-1)
+
+    def prod(v):
+        return producer.get(v, (None, None))
+
+    def single_use(v):
+        return len(consumers.get(v, ())) == 1
+
+    matches = []
+    for i2, dg2 in enumerate(jaxpr.eqns):
+        if dg2.primitive.name != 'dot_general':
+            continue
+        m = _AttnMatch()
+        m.dg2 = i2
+        a_var, v_var = dg2.invars
+        # (B,H,Tq,Tk) x (B,H,Tk,D): batch (0,1), contract 3 vs 2
+        dn = dg2.params['dimension_numbers']
+        if dn != (((3,), (2,)), ((0, 1), (0, 1))):
+            continue
+        # optional dtype cast between softmax and the AV matmul
+        ci, ce = prod(a_var)
+        if ce is not None and ce.primitive.name == 'convert_element_type' \
+                and single_use(a_var):
+            m.skip.add(ci)
+            a_var = ce.invars[0]
+        di, div_eqn = prod(a_var)
+        if div_eqn is None or div_eqn.primitive.name != 'div' \
+                or not single_use(a_var):
+            continue
+        exp_var, den_var = div_eqn.invars
+        ei, exp_eqn = prod(exp_var)
+        bi, bcast_eqn = prod(den_var)
+        if exp_eqn is None or exp_eqn.primitive.name != 'exp' or \
+                bcast_eqn is None or \
+                bcast_eqn.primitive.name != 'broadcast_in_dim':
+            continue
+        si, sum_eqn = prod(bcast_eqn.invars[0])
+        if sum_eqn is None or sum_eqn.primitive.name != 'reduce_sum' or \
+                sum_eqn.invars[0] is not exp_var:
+            continue
+        sbi, sub_eqn = prod(exp_eqn.invars[0])
+        if sub_eqn is None or sub_eqn.primitive.name != 'sub':
+            continue
+        scores_var, max_b_var = sub_eqn.invars
+        # max-subtraction chain: any ordering of broadcast_in_dim /
+        # stop_gradient / max(-inf, ·) around reduce_max(scores)
+        mchain = set()
+        cur = max_b_var
+        ok = False
+        for _ in range(5):
+            pi, pe = prod(cur)
+            if pe is None:
+                break
+            if pe.primitive.name in ('stop_gradient', 'broadcast_in_dim'):
+                mchain.add(pi)
+                cur = pe.invars[0]
+                continue
+            if pe.primitive.name == 'max':
+                mchain.add(pi)
+                cur = pe.invars[1] if _is_lit(pe.invars[0]) \
+                    else pe.invars[0]
+                continue
+            if pe.primitive.name == 'reduce_max' and \
+                    pe.invars[0] is scores_var:
+                mchain.add(pi)
+                ok = True
+            break
+        if not ok:
+            continue
+        scores_shape = tuple(scores_var.aval.shape)
+
+        # walk the pre-softmax chain down to the QK^T dot_general
+        chain = set()
+        cur = scores_var
+        dg1 = None
+        for _ in range(8):
+            pi, pe = prod(cur)
+            if pe is None:
+                break
+            if pe.primitive.name == 'dot_general':
+                dn1 = pe.params['dimension_numbers']
+                # K either arrives (B,H,Tk,D) (contract 3v3) or
+                # pre-transposed (B,H,D,Tk) (contract 3v2)
+                if dn1 == (((3,), (3,)), ((0, 1), (0, 1))):
+                    dg1 = (pi, pe, False)
+                elif dn1 == (((3,), (2,)), ((0, 1), (0, 1))):
+                    dg1 = (pi, pe, True)
+                break
+            if pe.primitive.name in ('div', 'mul'):
+                x, y = pe.invars
+                sl = _scalar_lit(y) if not _is_lit(x) else _scalar_lit(x)
+                t = y if _is_lit(x) else x
+                # no single-use requirement: chain vars are legitimately
+                # consumed twice inside the segment (reduce_max + sub),
+                # and the liveness pass resurrects anything consumed
+                # outside it
+                if sl is None:
+                    break
+                m.scale *= (1.0 / sl if pe.primitive.name == 'div' else sl)
+                chain.add(pi)
+                cur = t
+                continue
+            if pe.primitive.name == 'add' and m.add_mask is None:
+                x, y = pe.invars
+                other = None
+                for cand, tens in ((x, y), (y, x)):
+                    if _is_lit(cand):
+                        continue
+                    # the mask operand either IS key-mask-shaped
+                    # ((B,1,1,Tk) — lax.add broadcasts it in place) or is
+                    # an explicit broadcast_in_dim of such a tensor
+                    if _key_mask_shape(cand.aval, scores_shape):
+                        m.add_mask = cand
+                        # scales matched SO FAR sit between the add and
+                        # the softmax in the original program, so they
+                        # apply to the mask too: softmax((s+mask)/c) has
+                        # an effective additive bias of mask/c
+                        m.add_mask_scale = m.scale
+                        other = tens
+                        break
+                    ci2, ce2 = prod(cand)
+                    if ce2 is not None and \
+                            ce2.primitive.name == 'broadcast_in_dim' and \
+                            _key_mask_shape(ce2.invars[0].aval,
+                                            scores_shape):
+                        m.add_mask = ce2.invars[0]
+                        m.add_mask_scale = m.scale
+                        chain.add(ci2)
+                        other = tens
+                        break
+                if other is None:
+                    break
+                chain.add(pi)
+                cur = other
+                continue
+            if pe.primitive.name == 'select_n' and m.sel_mask is None:
+                pred, on_false, on_true = pe.invars
+                pi2, pe2 = prod(pred)
+                if pe2 is not None and \
+                        pe2.primitive.name == 'broadcast_in_dim' and \
+                        _key_mask_shape(pe2.invars[0].aval, scores_shape) \
+                        and _is_lit(on_false) is False:
+                    fi, fe = prod(on_false)
+                    # on_false must be a broadcast large-negative constant
+                    neg = None
+                    if fe is not None and \
+                            fe.primitive.name == 'broadcast_in_dim':
+                        neg = _scalar_lit(fe.invars[0])
+                        chain.add(fi)
+                    if neg is not None and neg < -1e20:
+                        m.sel_mask = pe2.invars[0]
+                        chain.add(pi2)
+                        chain.add(pi)
+                        cur = on_true
+                        continue
+                break
+            break
+        if dg1 is None:
+            continue
+        i1, dg1_eqn, k_t = dg1
+        m.dg1 = i1
+        m.q, m.k = dg1_eqn.invars
+        m.k_transposed = k_t
+        m.v = v_var
+        m.out_var = dg2.outvars[0]
+        m.skip |= chain | mchain | {i1, di, ei, bi, si, sbi, i2}
+        matches.append(m)
+    return matches
+
+
+def _fused_attention(q, k, v, scale, add_mask, add_mask_scale, sel_mask,
+                     out_aval, k_transposed=False):
+    from .ops.pallas_attention import flash_attention
+    if k_transposed:                       # (B,H,D,Tk) -> (B,H,Tk,D)
+        k = jnp.swapaxes(k, -1, -2)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    # flash_attention applies 1/sqrt(D) internally; fold the matched
+    # chain's scale (often the same 1/sqrt(D)) into q
+    qs = q * jnp.asarray(scale * math.sqrt(D), q.dtype)
+    km = None
+    if add_mask is not None:
+        km = add_mask.reshape(-1, Tk).astype(jnp.float32) * add_mask_scale
+        if km.shape[0] == 1:
+            km = jnp.broadcast_to(km, (B, Tk))
+    elif sel_mask is not None:
+        km = sel_mask.reshape(-1, Tk)
+        if km.shape[0] == 1:
+            km = jnp.broadcast_to(km, (B, Tk))
+        km = km.astype(jnp.bool_)
+    out = flash_attention(qs, k, v, key_mask=km)
+    return out.astype(out_aval.dtype)
+
+
+@register_backend
+class FuseAttentionBackend(SubgraphBackend):
+    """Swaps hand-written naive attention for the flash kernel."""
+
+    name = 'fuse_attention'
+
+    def rewrite(self, fn):
+        backend = self
+
+        def wrapped(*args):
+            # ONE trace: make_jaxpr(return_shape=True) yields the jaxpr
+            # and the output pytree together; both the match and no-match
+            # paths then evaluate the jaxpr instead of retracing fn
+            closed, out_shape = jax.make_jaxpr(
+                fn, return_shape=True)(*args)
+            out_tree = jax.tree_util.tree_structure(out_shape)
+            matches = _find_attention(closed.jaxpr)
+            backend.stats['matches'] += len(matches)
+            flat, _ = jax.tree_util.tree_flatten(args)
+            out_flat = _run_rewritten(closed, matches, flat)
+            return jax.tree_util.tree_unflatten(out_tree, out_flat)
+        return wrapped
+
+
+def _run_rewritten(closed, matches, flat_args):
+    """Evaluate `closed` with matched segments replaced by fused calls.
+
+    A matched segment's equations are candidates for skipping, but any of
+    them whose outputs are still consumed elsewhere (shared scores,
+    reused masks, jaxpr outputs) is resurrected by a reverse liveness
+    pass — correctness never depends on the matcher's single-consumer
+    checks alone."""
+    jaxpr = closed.jaxpr
+
+    by_dg2 = {m.dg2: m for m in matches}
+    skip = set()
+    for m in matches:
+        skip |= m.skip - {m.dg2}
+
+    # reverse liveness: seed with jaxpr outputs, live-eqn inputs and the
+    # fused calls' own inputs; resurrect skipped eqns whose outputs are
+    # needed, propagating their inputs
+    needed = {v for v in jaxpr.outvars if not _is_lit(v)}
+    for i, eqn in enumerate(jaxpr.eqns):
+        if i in skip:
+            continue
+        if i in by_dg2:
+            m = by_dg2[i]
+            for v in (m.q, m.k, m.v, m.add_mask, m.sel_mask):
+                if v is not None and not _is_lit(v):
+                    needed.add(v)
+            continue
+        for v in eqn.invars:
+            if not _is_lit(v):
+                needed.add(v)
+    for i in sorted(skip, reverse=True):
+        eqn = jaxpr.eqns[i]
+        if any(o in needed for o in eqn.outvars):
+            skip.discard(i)
+            for v in eqn.invars:
+                if not _is_lit(v):
+                    needed.add(v)
+
+    env = {}
+
+    def read(v):
+        return v.val if _is_lit(v) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        write(cv, cval)
+    for iv, a in zip(jaxpr.invars, flat_args):
+        write(iv, a)
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        m = by_dg2.get(i)
+        if m is not None:
+            out = _fused_attention(
+                read(m.q), read(m.k), read(m.v), m.scale,
+                None if m.add_mask is None else read(m.add_mask),
+                m.add_mask_scale,
+                None if m.sel_mask is None else read(m.sel_mask),
+                m.out_var.aval, m.k_transposed)
+            write(m.out_var, out)
+            continue
+        if i in skip:
+            continue
+        vals = [read(v) for v in eqn.invars]
+        ans = eqn.primitive.bind(*vals, **eqn.params)
+        if eqn.primitive.multiple_results:
+            for o, a in zip(eqn.outvars, ans):
+                write(o, a)
+        else:
+            write(eqn.outvars[0], ans)
+    return [read(v) for v in jaxpr.outvars]

@@ -1,0 +1,71 @@
+"""Profiler semantics (ref: python/mxnet/profiler.py + the per-op rows the
+reference's engine wrapping produces, src/profiler/profiler.h:299)."""
+import json
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+def _reset():
+    profiler.set_config(profile_imperative=False, profile_all=False,
+                        aggregate_stats=False, profile_sync=False,
+                        jax_trace_dir=None)
+
+
+def test_per_op_rows_and_aggregate_table():
+    profiler.set_config(profile_imperative=True, aggregate_stats=True)
+    profiler.start()
+    a = nd.ones((16, 16))
+    for _ in range(3):
+        nd.dot(a, a)
+    profiler.stop()
+    table = profiler.dumps()
+    assert 'dot' in table and 'Total Count' in table
+    row = [ln for ln in table.splitlines() if ln.startswith('dot')][0]
+    assert int(row.split()[1]) == 3
+    evs = json.loads(profiler.dumps(format='json'))['traceEvents']
+    ops = [e for e in evs if e['cat'] == 'operator']
+    assert len(ops) >= 3 and all('dur' in e for e in ops)
+    _reset()
+
+
+def test_set_config_rejects_unknown_keys():
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        profiler.set_config(not_a_real_key=True)
+
+
+def test_profiling_off_by_default():
+    profiler.start()
+    a = nd.ones((4, 4))
+    nd.dot(a, a)
+    profiler.stop()
+    evs = json.loads(profiler.dumps(format='json'))['traceEvents']
+    assert not [e for e in evs if e['cat'] == 'operator']
+    _reset()
+
+
+def test_jax_trace_started_via_api(tmp_path):
+    profiler.set_config(jax_trace_dir=str(tmp_path))
+    profiler.start()
+    nd.dot(nd.ones((8, 8)), nd.ones((8, 8))).wait_to_read()
+    profiler.stop()
+    files = [f for _, _, fs in os.walk(str(tmp_path)) for f in fs]
+    assert files, "no jax trace written"
+    _reset()
+
+
+def test_scopes_and_counters_still_work(tmp_path):
+    profiler.set_config(filename=str(tmp_path / 'p.json'))
+    profiler.start()
+    dom = profiler.Domain('test')
+    with dom.new_task('work'):
+        c = dom.new_counter('ctr', 1)
+        c += 2
+    profiler.stop()
+    profiler.dump()
+    data = json.load(open(str(tmp_path / 'p.json')))
+    names = [e['name'] for e in data['traceEvents']]
+    assert 'work' in names and 'ctr' in names
+    _reset()

@@ -1,0 +1,115 @@
+"""Subgraph partitioning backends (ref: src/operator/subgraph/
+subgraph_property.h + tests/python/unittest/test_subgraph_op.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.base import MXNetError
+
+
+class NaiveAttentionBlock(HybridBlock):
+    """Attention written BY HAND with separate ops — the pattern the
+    fuse_attention partitioner must recognise and swap for the flash
+    kernel."""
+
+    def __init__(self, hidden, heads, masked=False, **kwargs):
+        super().__init__(**kwargs)
+        self._h = heads
+        self._masked = masked
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * hidden, flatten=False, in_units=hidden)
+            self.proj = nn.Dense(hidden, flatten=False, in_units=hidden)
+
+    def forward(self, x, valid_len=None):
+        N, T, C = x.shape
+        H = self._h
+        D = C // H
+        qkv = self.qkv(x)
+        q, k, v = qkv.split(3, axis=-1)
+        q = q.reshape(N, T, H, D).transpose((0, 2, 1, 3))
+        k = k.reshape(N, T, H, D).transpose((0, 2, 1, 3))
+        v = v.reshape(N, T, H, D).transpose((0, 2, 1, 3))
+        scores = nd.batch_dot(q, k, transpose_b=True) / (D ** 0.5)
+        if self._masked and valid_len is not None:
+            m = (nd.arange(0, T, dtype='float32').reshape(1, 1, 1, T) <
+                 valid_len.reshape(-1, 1, 1, 1))
+            big = nd.full((1,), -1e30).reshape(1, 1, 1, 1)
+            scores = scores + (1.0 - m) * big
+        att = nd.softmax(scores, axis=-1)
+        out = nd.batch_dot(att, v)
+        out = out.transpose((0, 2, 1, 3)).reshape(N, T, C)
+        return self.proj(out)
+
+
+def _make(masked):
+    mx.random.seed(5)
+    blk = NaiveAttentionBlock(32, 4, masked=masked)
+    blk.initialize(mx.init.Xavier())
+    return blk
+
+
+def test_fuse_attention_backend_matches_unfused():
+    x = nd.array(onp.random.RandomState(0)
+                 .randn(2, 24, 32).astype(onp.float32))
+    blk = _make(False)
+    ref = blk(x).asnumpy()
+    blk.hybridize(backend='fuse_attention')
+    out = blk(x).asnumpy()
+    assert blk._subgraph_backend.stats['matches'] >= 1, \
+        "partitioner found no attention subgraph"
+    assert onp.allclose(out, ref, rtol=1e-4, atol=1e-5), \
+        onp.abs(out - ref).max()
+
+
+def test_fuse_attention_backward_matches():
+    from mxnet_tpu import autograd
+    x = nd.array(onp.random.RandomState(1)
+                 .randn(2, 16, 32).astype(onp.float32))
+    grads = {}
+    for backend in (None, 'fuse_attention'):
+        blk = _make(False)
+        if backend:
+            blk.hybridize(backend=backend)
+        xx = nd.array(x.asnumpy())
+        xx.attach_grad()
+        with autograd.record():
+            y = blk(xx).sum()
+        y.backward()
+        grads[backend] = xx.grad.asnumpy()
+    assert onp.allclose(grads[None], grads['fuse_attention'],
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_unknown_backend_rejected():
+    blk = _make(False)
+    with pytest.raises(MXNetError, match='not registered'):
+        blk.hybridize(backend='definitely_not_a_backend')
+
+
+def test_backend_noop_on_unmatched_graph():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 4))
+    ref = net(x).asnumpy()
+    net.hybridize(backend='fuse_attention')
+    out = net(x).asnumpy()
+    assert onp.allclose(out, ref, atol=1e-6)
+
+
+def test_fuse_attention_with_additive_key_mask():
+    """The partitioner also matches attention with an additive key-padding
+    mask and routes it into the kernel's key_mask argument."""
+    x = nd.array(onp.random.RandomState(2)
+                 .randn(2, 24, 32).astype(onp.float32))
+    vlen = nd.array(onp.array([15, 24], onp.float32))
+    blk = _make(True)
+    ref = blk(x, vlen).asnumpy()
+    blk.hybridize(backend='fuse_attention')
+    out = blk(x, vlen).asnumpy()
+    assert blk._subgraph_backend.stats['matches'] >= 1
+    assert onp.allclose(out, ref, rtol=1e-4, atol=1e-5), \
+        onp.abs(out - ref).max()
