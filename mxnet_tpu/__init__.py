@@ -35,6 +35,8 @@ from . import gluon
 from . import profiler
 from . import callback
 from . import runtime
+from . import config
+from . import subgraph
 from . import engine
 from . import util
 from . import test_utils
@@ -54,3 +56,12 @@ from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
            'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError']
+
+
+# env-var configuration applied at import (ref: the reference's
+# read-at-startup vars, docs/faq/env_var.md)
+import os as _os  # noqa: E402
+if _os.environ.get('MXNET_SEED'):
+    seed(config.get('MXNET_SEED'))
+if config.get('MXNET_PROFILER_AUTOSTART'):
+    profiler.start()

@@ -1,0 +1,159 @@
+"""Environment-variable configuration tier (ref: docs/faq/env_var.md +
+the dmlc GetEnv calls spread through src/).
+
+The reference configures its runtime through ~60 documented MXNET_* env
+vars read at first use. This module is the TPU-native registry: every
+supported variable is declared once with a type, default and help string;
+`config.get('MXNET_...')` reads the process environment through that
+declaration, `describe()` prints the documented surface, and variables
+whose CUDA-era meaning has no TPU analog are declared `inert=True` so
+user scripts that set them keep working while `describe()` says why they
+do nothing here (XLA owns scheduling/memory/fusion).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+from .base import MXNetError
+
+__all__ = ['EnvVar', 'register', 'get', 'set_env', 'describe', 'list_vars']
+
+
+class EnvVar(NamedTuple):
+    name: str
+    type: Callable
+    default: Any
+    help: str
+    inert: bool = False     # accepted but a no-op on TPU (documented why)
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name, type_, default, help_, inert=False):
+    _REGISTRY[name] = EnvVar(name, type_, default, help_, inert)
+    return _REGISTRY[name]
+
+
+def _bool(s):
+    return str(s).lower() not in ('0', 'false', 'off', '', 'no', 'n',
+                                  'none', 'disabled')
+
+
+def get(name, default=None):
+    """Typed value of a declared env var (process env > declared default >
+    `default`)."""
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise MXNetError(
+            f"unknown config variable {name!r}; see "
+            f"mxnet_tpu.config.list_vars()")
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default if default is None else default
+    try:
+        return var.type(raw)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(
+            f"{name}={raw!r} is not a valid {var.type.__name__}") from e
+
+
+def set_env(name, value):
+    """Set a declared variable in the process environment (takes effect at
+    the next read — matching the reference's read-at-first-use rule)."""
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown config variable {name!r}")
+    os.environ[name] = str(value)
+
+
+def list_vars():
+    return sorted(_REGISTRY)
+
+
+def describe(name: Optional[str] = None):
+    """Documentation string for one or all declared variables."""
+    names = [name] if name else list_vars()
+    lines = []
+    for n in names:
+        v = _REGISTRY[n]
+        cur = os.environ.get(n)
+        tag = ' [inert on TPU]' if v.inert else ''
+        lines.append(f"{v.name} (type={v.type.__name__}, "
+                     f"default={v.default!r}"
+                     + (f", set={cur!r}" if cur is not None else '')
+                     + f"){tag}\n    {v.help}")
+    return '\n'.join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the supported surface
+# ---------------------------------------------------------------------------
+
+register('MXNET_HOME', str,
+         os.path.join(os.path.expanduser('~'), '.mxnet'),
+         'Data directory: model-store cache, datasets.')
+register('MXNET_GLUON_REPO', str,
+         'https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/',
+         'Base URL (or local directory) for pretrained model downloads.')
+register('MXNET_TEST_DEVICE', str, 'cpu',
+         'Device used by test_utils.default_context().')
+register('MXNET_STORAGE_FALLBACK_LOG_VERBOSE', _bool, True,
+         'Log when a sparse op falls back to the dense implementation.')
+register('MXNET_ENFORCE_DETERMINISM', _bool, False,
+         'Restrict ops to deterministic algorithms. XLA on TPU is '
+         'deterministic by default; this additionally pins the framework '
+         'RNG seeding of data iterators.')
+register('MXNET_SAFE_ACCUMULATION', _bool, True,
+         'Accumulate reductions of low-precision inputs in float32 '
+         '(layer norm / softmax statistics already do this on TPU).')
+register('MXNET_TPU_JAX_TRACE_DIR', str, '',
+         'Directory for the XLA device trace started by profiler.start().')
+register('MXNET_PROFILER_AUTOSTART', _bool, False,
+         'Start the profiler at import time.')
+register('MXNET_KVSTORE_BIGARRAY_BOUND', int, 1000000,
+         'Arrays above this element count use sharded collectives in the '
+         'kvstore reduce path.')
+register('MXNET_KVSTORE_USETREE', _bool, False,
+         'Reference: tree reduction for multi-GPU. Collective layout on '
+         'TPU is chosen by XLA over the ICI topology.', inert=True)
+register('MXNET_ENABLE_GPU_P2P', _bool, True,
+         'Reference: CUDA peer-to-peer. ICI links are always direct.',
+         inert=True)
+register('MXNET_ENGINE_TYPE', str, 'ThreadedEnginePerDevice',
+         'Reference: dependency-engine selection. The XLA async runtime '
+         'is the engine on TPU; accepted for script compatibility.',
+         inert=True)
+register('MXNET_EXEC_BULK_EXEC_TRAIN', _bool, True,
+         'Reference: bulk execution of the graph. jit compilation '
+         'subsumes it.', inert=True)
+register('MXNET_EXEC_BULK_EXEC_INFERENCE', _bool, True,
+         'Reference: bulk execution for inference. jit subsumes it.',
+         inert=True)
+register('MXNET_EXEC_ENABLE_INPLACE', _bool, True,
+         'Reference: in-place graph optimization. XLA buffer donation '
+         'subsumes it.', inert=True)
+register('MXNET_GPU_MEM_POOL_TYPE', str, 'Naive',
+         'Reference: CUDA memory pool strategy. Device memory on TPU is '
+         'owned by PJRT/XLA.', inert=True)
+register('MXNET_GPU_MEM_POOL_RESERVE', int, 5,
+         'Reference: CUDA pool reserve percentage. PJRT-owned on TPU.',
+         inert=True)
+register('MXNET_CPU_WORKER_NTHREADS', int, 1,
+         'Reference: CPU op worker threads. XLA:CPU threadpools are '
+         'sized automatically.', inert=True)
+register('MXNET_OMP_MAX_THREADS', int, 0,
+         'Reference: OpenMP cap. XLA-managed on this stack.', inert=True)
+register('MXNET_CUDNN_AUTOTUNE_DEFAULT', int, 1,
+         'Reference: cuDNN autotuning. The XLA TPU compiler autotunes '
+         'during compilation.', inert=True)
+register('MXNET_ENABLE_OPERATOR_TUNING', int, 1,
+         'Reference: CPU op tuning. XLA-managed.', inert=True)
+register('MXNET_MEMORY_OPT', int, 0,
+         'Reference: memory-optimization pass. Use jax.checkpoint / '
+         'remat policies instead.', inert=True)
+register('MXNET_SUBGRAPH_BACKEND', str, '',
+         'Default subgraph partitioner applied by hybridize() when the '
+         'call does not name one (see mxnet_tpu.subgraph).')
+register('MXNET_SEED', int, 0,
+         'Process-wide RNG seed applied at import when set.')

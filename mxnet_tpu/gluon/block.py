@@ -272,6 +272,9 @@ class HybridBlock(Block):
         (src/operator/subgraph/subgraph_property.h:252). None keeps the
         plain XLA compilation path."""
         self._active = active
+        if backend is None:
+            from .. import config as _config
+            backend = _config.get('MXNET_SUBGRAPH_BACKEND') or None
         if backend is not None:
             from .. import subgraph as _subgraph
             self._subgraph_backend = _subgraph.get_backend(backend)

@@ -58,14 +58,12 @@ _model_sha1 = {name: checksum for checksum, name in [
     ('ad2f660d101905472b83590b59708b71ea22b2e5', 'vgg19'),
     ('f360b758e856f1074a85abd5fd873ed1d98297c3', 'vgg19_bn')]}
 
-apache_repo_url = \
-    'https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/'
 _url_format = '{repo_url}gluon/models/{file_name}.zip'
 
 
 def _data_dir():
-    return os.environ.get('MXNET_HOME',
-                          os.path.join(os.path.expanduser('~'), '.mxnet'))
+    from ... import config
+    return config.get('MXNET_HOME')
 
 
 def short_hash(name):
@@ -123,7 +121,8 @@ def get_model_file(name, root=None):
         logging.info('Model file not found. Downloading to %s.', file_path)
 
     os.makedirs(root, exist_ok=True)
-    repo_url = os.environ.get('MXNET_GLUON_REPO', apache_repo_url)
+    from ... import config
+    repo_url = config.get('MXNET_GLUON_REPO')
     if repo_url[-1] != '/':
         repo_url += '/'
     src = _url_format.format(repo_url=repo_url, file_name=file_name)

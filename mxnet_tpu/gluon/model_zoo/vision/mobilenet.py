@@ -109,10 +109,8 @@ class MobileNetV2(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        version_suffix = '{0:.2f}'.format(multiplier)
-        if version_suffix in ('1.00', '0.50'):
-            version_suffix = version_suffix[:-1]
-        _load_pretrained(net, 'mobilenet' + version_suffix, root, ctx)
+        _load_pretrained(net, 'mobilenet' + _version_suffix(multiplier),
+                         root, ctx)
     return net
 
 
@@ -120,10 +118,8 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        version_suffix = '{0:.2f}'.format(multiplier)
-        if version_suffix in ('1.00', '0.50'):
-            version_suffix = version_suffix[:-1]
-        _load_pretrained(net, 'mobilenetv2_' + version_suffix, root, ctx)
+        _load_pretrained(net, 'mobilenetv2_' + _version_suffix(multiplier),
+                         root, ctx)
     return net
 
 
@@ -157,6 +153,15 @@ def mobilenet_v2_0_5(**kwargs):
 
 def mobilenet_v2_0_25(**kwargs):
     return get_mobilenet_v2(0.25, **kwargs)
+
+
+def _version_suffix(multiplier):
+    """Multiplier formatted as the model-store name suffix ('1.0', '0.25',
+    ...) matching the _model_sha1 table keys."""
+    suffix = '{0:.2f}'.format(multiplier)
+    if suffix in ('1.00', '0.50'):
+        suffix = suffix[:-1]
+    return suffix
 
 
 from ..model_store import load_pretrained as _load_pretrained  # noqa: E402

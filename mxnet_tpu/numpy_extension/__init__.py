@@ -120,3 +120,81 @@ def seed(s):
 def waitall():
     from ..ndarray import waitall as _w
     _w()
+
+
+def save(file, arr):
+    """Save dict/list of np.ndarray in the binary .params container
+    (ref: numpy_extension/utils.py save)."""
+    from .. import ndarray as _nd
+    if isinstance(arr, dict):
+        _nd.save(file, {k: _nd.array(_unwrap(v)) for k, v in arr.items()})
+    else:
+        if not isinstance(arr, (list, tuple)):
+            arr = [arr]
+        _nd.save(file, [_nd.array(_unwrap(a)) for a in arr])
+
+
+def load(file):
+    """Load .params into np.ndarray (ref: numpy_extension/utils.py)."""
+    from .. import ndarray as _nd
+    out = _nd.load(file)
+    if isinstance(out, dict):
+        return {k: ndarray(v._data) for k, v in out.items()}
+    return [ndarray(v._data) for v in out]
+
+
+class random:
+    """npx.random — sampler variants that draw one batch per parameter row
+    (ref: numpy_extension/random.py bernoulli/normal_n/uniform_n)."""
+
+    @staticmethod
+    def bernoulli(prob=0.5, size=None, dtype='float32'):
+        from ..base import get_op
+        return ndarray(get_op('_npi_bernoulli').fn(
+            _unwrap(prob), size=size, dtype=dtype))
+
+    @staticmethod
+    def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype='float32'):
+        from ..base import get_op
+        shp = None
+        if batch_shape is not None:
+            shp = tuple(batch_shape) + jnp.shape(_unwrap(loc))
+        return ndarray(get_op('_npi_normal').fn(
+            _unwrap(loc), _unwrap(scale), size=shp, dtype=dtype))
+
+    @staticmethod
+    def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype='float32'):
+        from ..base import get_op
+        shp = None
+        if batch_shape is not None:
+            shp = tuple(batch_shape) + jnp.shape(_unwrap(low))
+        return ndarray(get_op('_npi_uniform').fn(
+            _unwrap(low), _unwrap(high), size=shp, dtype=dtype))
+
+    seed = staticmethod(seed)
+
+
+def __getattr__(name):
+    """Any registered operator is reachable as npx.<name> — the analog of
+    the reference generating the npx namespace from the op registry
+    (ref: python/mxnet/numpy_extension/_register.py). Explicit wrappers
+    above take precedence; everything else resolves here on first use."""
+    if name.startswith('_'):
+        raise AttributeError(name)
+    from ..base import get_op, MXNetError
+    try:
+        op = get_op(name)
+    except MXNetError:
+        raise AttributeError(
+            f"module 'mxnet_tpu.numpy_extension' has no attribute "
+            f"{name!r}") from None
+
+    def f(*args, **kwargs):
+        out = op.fn(*[_unwrap(a) for a in args],
+                    **{k: _unwrap(v) for k, v in kwargs.items()})
+        return _wrap_out(out)
+    f.__name__ = name
+    f.__qualname__ = name
+    f.__doc__ = op.doc
+    globals()[name] = f     # cache for subsequent lookups
+    return f

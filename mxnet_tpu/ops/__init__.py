@@ -23,6 +23,7 @@ from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
 from . import numpy_ops   # noqa: F401
 from . import sparse_ops  # noqa: F401
+from . import graph      # noqa: F401
 
 from .elemwise import *     # noqa: F401,F403
 from .reduce import *       # noqa: F401,F403

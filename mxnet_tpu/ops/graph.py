@@ -1,0 +1,166 @@
+"""DGL graph ops (ref: src/operator/contrib/dgl_graph.cc).
+
+Graphs ride in CSR matrices whose stored values are edge ids (the DGL
+convention). `edge_id`, `dgl_adjacency` and `dgl_subgraph` are pure
+gathers and lower through XLA; the neighbor samplers and graph
+compaction have value-dependent output structure, so — exactly like the
+reference's CPU kernels (dgl_graph.cc runs them on the host and syncs) —
+they execute eagerly over host numpy and are not jit-traceable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import register_op
+from .. import random as _random
+
+__all__ = []
+
+
+def _reg(fn, **kw):
+    register_op(fn.__name__, **kw)(fn)
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _host(x):
+    return onp.asarray(jax.device_get(x))
+
+
+@_reg
+def edge_id(data, u, v):
+    """out[i] = data[u[i], v[i]] if that edge exists else -1
+    (ref: dgl_graph.cc:1300 _contrib_edge_id)."""
+    vals = data[u.astype(jnp.int32), v.astype(jnp.int32)]
+    return jnp.where(vals != 0, vals, -jnp.ones_like(vals))
+
+
+@_reg
+def dgl_adjacency(data):
+    """Adjacency matrix (all stored edges become weight 1.0) of an
+    edge-id CSR (ref: dgl_graph.cc:1376)."""
+    return (data != 0).astype(jnp.float32)
+
+
+def dgl_subgraph(graph, *vertex_lists, return_mapping=False):
+    """Induced subgraphs on the given vertex sets (ref:
+    dgl_graph.cc:1115). Returns one (sub)graph per vertex list, each
+    followed by its edge-id mapping matrix when return_mapping=True."""
+    g = _host(graph)
+    outs = []
+    for vl in vertex_lists:
+        idx = _host(vl).astype(onp.int64)
+        sub = g[onp.ix_(idx, idx)]
+        # renumber edges consecutively like the reference (ids start at 1)
+        mask = sub != 0
+        new = onp.zeros_like(sub)
+        new[mask] = onp.arange(1, int(mask.sum()) + 1)
+        outs.append(jnp.asarray(new))
+        if return_mapping:
+            mapping = onp.where(mask, sub, 0)
+            outs.append(jnp.asarray(mapping))
+    return tuple(outs)
+
+
+register_op('dgl_subgraph', num_outputs=-1, nograd=True)(dgl_subgraph)
+__all__.append('dgl_subgraph')
+
+
+def _neighbor_sample(csr, seeds, num_hops, num_neighbor,
+                     max_num_vertices, probability=None):
+    """Shared body of the two samplers (ref: dgl_graph.cc SampleSubgraph):
+    BFS from the seed set, keeping <=num_neighbor sampled neighbors per
+    vertex per hop; emits (vertices, sampled-edge csr payload, layers)."""
+    g = _host(csr)
+    n = g.shape[0]
+    rng = onp.random.RandomState(
+        int(_host(jax.random.bits(_random.next_key(), (), jnp.uint32))))
+    prob = None if probability is None else _host(probability)
+
+    layer_of = {}
+    frontier = []
+    for s in _host(seeds).astype(onp.int64).ravel():
+        if len(layer_of) >= max_num_vertices:
+            break   # the cap applies to seeds too, not just neighbors
+        if s >= 0 and s not in layer_of:
+            layer_of[int(s)] = 0
+            frontier.append(int(s))
+    sampled = onp.zeros_like(g)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for u in frontier:
+            nbrs = onp.nonzero(g[u])[0]
+            if len(nbrs) == 0:
+                continue
+            if len(nbrs) > num_neighbor:
+                if prob is not None:
+                    p = prob[nbrs].astype(onp.float64)
+                    p = p / p.sum()
+                    pick = rng.choice(nbrs, num_neighbor, replace=False,
+                                      p=p)
+                else:
+                    pick = rng.choice(nbrs, num_neighbor, replace=False)
+            else:
+                pick = nbrs
+            for vtx in pick:
+                if len(layer_of) >= max_num_vertices and \
+                        int(vtx) not in layer_of:
+                    continue
+                sampled[u, vtx] = g[u, vtx]
+                if int(vtx) not in layer_of:
+                    layer_of[int(vtx)] = hop
+                    nxt.append(int(vtx))
+        frontier = nxt
+    verts = sorted(layer_of)
+    out_v = onp.full((max_num_vertices + 1,), -1, onp.int64)
+    out_v[:len(verts)] = verts
+    out_v[-1] = len(verts)
+    out_l = onp.full((max_num_vertices,), -1, onp.int64)
+    out_l[:len(verts)] = [layer_of[v] for v in verts]
+    return (jnp.asarray(out_v), jnp.asarray(sampled), jnp.asarray(out_l))
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """Uniform neighborhood sampling from an edge-id CSR graph (ref:
+    dgl_graph.cc:744). One (vertices, subgraph-csr, layers) triple per
+    seed array."""
+    outs = []
+    for s in seeds:
+        outs.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                     max_num_vertices))
+    return tuple(outs)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted neighborhood sampling
+    (ref: dgl_graph.cc:838)."""
+    outs = []
+    for s in seeds:
+        outs.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                     max_num_vertices, probability))
+    return tuple(outs)
+
+
+def dgl_graph_compact(*graphs, return_mapping=False, graph_sizes=()):
+    """Drop unused vertex slots: each input graph keeps its first
+    graph_sizes[i] vertices (ref: dgl_graph.cc:1551)."""
+    outs = []
+    for g, size in zip(graphs, graph_sizes):
+        gh = _host(g)
+        size = int(size)
+        compact = gh[:size, :size]
+        outs.append(jnp.asarray(compact))
+        if return_mapping:
+            outs.append(jnp.asarray((compact != 0).astype(gh.dtype)))
+    return tuple(outs)
+
+
+for _f in (dgl_csr_neighbor_uniform_sample,
+           dgl_csr_neighbor_non_uniform_sample, dgl_graph_compact):
+    register_op(_f.__name__, num_outputs=-1, nograd=True)(_f)
+    __all__.append(_f.__name__)

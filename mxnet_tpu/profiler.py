@@ -113,8 +113,9 @@ def start(profile_process='worker'):
     with _events_lock:
         _op_stats.clear()
     _sync_flags()
+    from . import config as _envcfg
     tdir = _config['jax_trace_dir'] or \
-        os.environ.get('MXNET_TPU_JAX_TRACE_DIR')
+        _envcfg.get('MXNET_TPU_JAX_TRACE_DIR')
     if tdir:
         jax.profiler.start_trace(tdir)
         _state['jax_trace_dir'] = tdir

@@ -1,0 +1,50 @@
+"""Env-var configuration tier (ref: docs/faq/env_var.md surface)."""
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu.base import MXNetError
+
+
+def test_declared_vars_typed_reads(monkeypatch):
+    assert 'MXNET_HOME' in config.list_vars()
+    monkeypatch.setenv('MXNET_KVSTORE_BIGARRAY_BOUND', '12345')
+    assert config.get('MXNET_KVSTORE_BIGARRAY_BOUND') == 12345
+    monkeypatch.setenv('MXNET_ENFORCE_DETERMINISM', 'true')
+    assert config.get('MXNET_ENFORCE_DETERMINISM') is True
+    monkeypatch.delenv('MXNET_KVSTORE_BIGARRAY_BOUND')
+    assert config.get('MXNET_KVSTORE_BIGARRAY_BOUND') == 1000000
+
+
+def test_unknown_and_invalid_rejected(monkeypatch):
+    with pytest.raises(MXNetError, match='unknown'):
+        config.get('MXNET_NOT_A_VAR')
+    with pytest.raises(MXNetError, match='unknown'):
+        config.set_env('MXNET_NOT_A_VAR', 1)
+    monkeypatch.setenv('MXNET_SEED', 'not-an-int')
+    with pytest.raises(MXNetError, match='not a valid'):
+        config.get('MXNET_SEED')
+
+
+def test_describe_documents_inert_vars():
+    doc = config.describe('MXNET_ENGINE_TYPE')
+    assert 'inert on TPU' in doc and 'XLA' in doc
+    full = config.describe()
+    assert 'MXNET_GLUON_REPO' in full
+
+
+def test_subgraph_backend_env_default(monkeypatch):
+    import numpy as onp
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    monkeypatch.setenv('MXNET_SUBGRAPH_BACKEND', 'fuse_attention')
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    assert net._subgraph_backend is not None
+    assert net._subgraph_backend.name == 'fuse_attention'
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 4)

@@ -257,3 +257,65 @@ def test_correlation_self_is_l2norm():
     center = corr.asnumpy()[0, 4]
     ref = (d1[0] ** 2).mean(0)
     assert_almost_equal(center, ref, rtol=1e-4)
+
+
+def test_dgl_graph_ops():
+    """DGL graph op family (ref: src/operator/contrib/dgl_graph.cc
+    docstring examples)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.base import get_op
+
+    # edge_id: reference example
+    x = jnp.asarray([[1, 0, 0], [0, 2, 0], [0, 0, 3]], jnp.float32)
+    u = jnp.asarray([0, 0, 1, 1, 2, 2])
+    v = jnp.asarray([0, 1, 1, 2, 0, 2])
+    out = get_op('edge_id').fn(x, u, v)
+    assert onp.array_equal(onp.asarray(out), [1, -1, 2, -1, -1, 3])
+
+    # adjacency
+    adj = get_op('dgl_adjacency').fn(x)
+    assert onp.array_equal(onp.asarray(adj), onp.eye(3))
+
+    # subgraph: induced on vertices [0, 2]
+    g = jnp.asarray([[0, 1, 2], [3, 0, 4], [5, 6, 0]], jnp.float32)
+    sub, mapping = get_op('dgl_subgraph').fn(
+        g, jnp.asarray([0, 2]), return_mapping=True)
+    assert sub.shape == (2, 2)
+    assert onp.asarray(mapping)[0, 1] == 2.0   # original edge id kept
+    assert onp.asarray(mapping)[1, 0] == 5.0
+
+    # uniform neighbor sampling on the reference's 5-vertex clique
+    data_np = onp.arange(1, 21, dtype=onp.float32)
+    dense = onp.zeros((5, 5), onp.float32)
+    indices = [1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4, 0, 1, 2, 4, 0, 1, 2, 3]
+    indptr = [0, 4, 8, 12, 16, 20]
+    for row in range(5):
+        for j in range(indptr[row], indptr[row + 1]):
+            dense[row, indices[j]] = data_np[j]
+    seed = jnp.asarray([0, 1, 2, 3, 4])
+    verts, subg, layers = get_op('dgl_csr_neighbor_uniform_sample').fn(
+        jnp.asarray(dense), seed, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    verts = onp.asarray(verts)
+    assert verts[-1] == 5                      # all 5 seeds are vertices
+    assert onp.array_equal(verts[:5], [0, 1, 2, 3, 4])
+    subg = onp.asarray(subg)
+    # every seed sampled at most num_neighbor edges, values are edge ids
+    assert ((subg != 0).sum(axis=1) <= 2).all()
+    nz = subg[subg != 0]
+    assert set(nz.tolist()) <= set(data_np.tolist())
+    assert onp.asarray(layers)[:5].max() <= 1
+
+    # non-uniform: zero probability mass on vertices 2..4 forces samples
+    # into {0, 1} columns for every seed
+    prob = jnp.asarray([1.0, 1.0, 0.0, 0.0, 0.0])
+    _, subg2, _ = get_op('dgl_csr_neighbor_non_uniform_sample').fn(
+        jnp.asarray(dense), prob, seed, num_hops=1, num_neighbor=1,
+        max_num_vertices=5)
+    cols = onp.nonzero(onp.asarray(subg2))[1]
+    assert set(cols.tolist()) <= {0, 1}, cols
+
+    # compact
+    comp, = get_op('dgl_graph_compact').fn(
+        jnp.asarray(dense), graph_sizes=(3,))
+    assert comp.shape == (3, 3)

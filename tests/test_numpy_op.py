@@ -65,3 +65,42 @@ def test_interop_with_nd():
     assert type(n).__name__ == 'NDArray'
     back = n.as_np_ndarray() if hasattr(n, 'as_np_ndarray') else mnp.array(n)
     assert_almost_equal(back, onp.array([[1.0, 2.0]]))
+
+
+def test_npx_registry_bridge():
+    """npx resolves ANY registered op on first use (the reference
+    generates npx from the op registry, numpy_extension/_register.py)."""
+    import pytest
+    import mxnet_tpu as mx
+    np, npx = mx.np, mx.npx
+    a = np.array([[1., 2.], [3., 4.]])
+    out = npx.leaky_relu(a)
+    assert out.shape == (2, 2)
+    assert float(npx.erf(np.array([0.0]))[0]) == 0.0
+    # explicit wrappers still win over the generic bridge
+    assert npx.softmax(a).shape == (2, 2)
+    with pytest.raises(AttributeError):
+        npx.definitely_not_an_op
+
+
+def test_npx_save_load_roundtrip(tmp_path):
+    import mxnet_tpu as mx
+    np, npx = mx.np, mx.npx
+    a = np.array([[1., 2.], [3., 4.]])
+    f = str(tmp_path / 'x.params')
+    npx.save(f, {'a': a})
+    back = npx.load(f)
+    assert onp.allclose(back['a'].asnumpy(), a.asnumpy())
+
+
+def test_npx_random_samplers():
+    import mxnet_tpu as mx
+    npx = mx.npx
+    mx.random.seed(0)
+    s = npx.random.bernoulli(0.5, size=(500,))
+    m = float(s.asnumpy().mean())
+    assert 0.35 < m < 0.65
+    n = npx.random.normal_n(0.0, 1.0, batch_shape=(64,))
+    assert n.shape == (64,)
+    u = npx.random.uniform_n(0.0, 1.0, batch_shape=(8,))
+    assert u.shape == (8,) and 0 <= float(u.asnumpy().min())

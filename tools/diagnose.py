@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Environment diagnosis (ref: tools/diagnose.py): python / framework /
+OS / accelerator / env-var report for bug filing."""
+import argparse
+import os
+import platform
+import sys
+import time
+
+
+def check_python():
+    print('----------Python Info----------')
+    print('Version      :', platform.python_version())
+    print('Compiler     :', platform.python_compiler())
+    print('Build        :', platform.python_build())
+    print('Arch         :', platform.architecture())
+
+
+def check_framework():
+    print('----------Framework Info----------')
+    try:
+        import mxnet_tpu as mx
+        print('Version      :', getattr(mx, '__version__', 'dev'))
+        print('Directory    :', os.path.dirname(mx.__file__))
+        from mxnet_tpu import runtime
+        feats = runtime.Features()
+        on = [f for f in feats.values() if f.enabled]
+        print('Features     :', ' '.join(sorted(f.name for f in on)))
+    except Exception as e:
+        print('import failed:', repr(e))
+
+
+def check_accelerator():
+    print('----------Accelerator Info----------')
+    try:
+        import jax
+        t0 = time.time()
+        devices = jax.devices()
+        print('Backend      :', jax.default_backend())
+        print('Devices      :', devices)
+        print('Device count :', len(devices))
+        print('Probe time   : %.3fs' % (time.time() - t0))
+    except Exception as e:
+        print('jax backend unavailable:', repr(e))
+
+
+def check_os():
+    print('----------System Info----------')
+    print('Platform     :', platform.platform())
+    print('System       :', platform.system())
+    print('Node         :', platform.node())
+    print('Release      :', platform.release())
+
+
+def check_environment():
+    print('----------Environment----------')
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(('MXNET_', 'JAX_', 'XLA_', 'LIBTPU',
+                         'TPU_')):
+            print(f'{k}={v}')
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description='Diagnose the environment')
+    p.parse_args(argv)
+    check_python()
+    check_framework()
+    check_accelerator()
+    check_os()
+    check_environment()
+
+
+if __name__ == '__main__':
+    main()

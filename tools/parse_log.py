@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table (ref: tools/parse_log.py).
+
+Reads the epoch lines the fit/estimator loops emit
+(`Epoch[3] Train-accuracy=0.92`, `Epoch[3] Validation-accuracy=0.89`,
+`Epoch[3] Time cost=12.3`) and prints one row per epoch.
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    pats = (
+        [(f'train-{m}', re.compile(
+            r'.*Epoch\[(\d+)\] Train-' + m + r'.*=([.\d]+)'))
+         for m in metric_names]
+        + [(f'val-{m}', re.compile(
+            r'.*Epoch\[(\d+)\] Validation-' + m + r'.*=([.\d]+)'))
+           for m in metric_names]
+        + [('time', re.compile(r'.*Epoch\[(\d+)\] Time.*=([.\d]+)'))])
+    data = {}
+    for line in lines:
+        for name, pat in pats:
+            m = pat.match(line)
+            if m is not None:
+                epoch = int(m.group(1))
+                data.setdefault(epoch, {})[name] = float(m.group(2))
+                break
+    cols = [n for n, _ in pats]
+    return data, cols
+
+
+def to_markdown(data, cols):
+    out = ['| epoch | ' + ' | '.join(cols) + ' |',
+           '| --- |' + ' --- |' * len(cols)]
+    for epoch in sorted(data):
+        row = data[epoch]
+        out.append('| %d | %s |' % (
+            epoch, ' | '.join('%.6g' % row[c] if c in row else ''
+                              for c in cols)))
+    return '\n'.join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description='Parse training output log')
+    p.add_argument('logfile', type=str)
+    p.add_argument('--format', type=str, default='markdown',
+                   choices=['markdown', 'none'])
+    p.add_argument('--metric-names', type=str, nargs='+',
+                   default=['accuracy'])
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        data, cols = parse(f.readlines(), args.metric_names)
+    if args.format == 'markdown':
+        print(to_markdown(data, cols))
+    return data
+
+
+if __name__ == '__main__':
+    main()
