@@ -1,9 +1,11 @@
 """Reference model implementations used by benchmarks and examples.
 
 LeNet (ref: example/gluon/mnist), BERT-base (GluonNLP recipe — the north
-star config), Transformer (example/gluon/transformer shape), built on
+star config), Transformer (example/gluon/transformer shape), GPT-style
+causal LM (decoder-only over the flash kernel's causal path), built on
 mxnet_tpu.gluon.
 """
 from .lenet import LeNet
 from .bert import BertModel, BertForPretraining, bert_base_config, bert_pretrain_loss
 from .transformer import TransformerEncoder, TransformerModel
+from .gpt import GPTModel, gpt_lm_loss, gpt2_small_config

@@ -157,17 +157,20 @@ class BertForPretraining(HybridBlock):
         return mlm, nsp
 
 
+def masked_cross_entropy(logits, labels):
+    """Mean cross entropy over the positions where labels >= 0 (-1 marks
+    padding/unmasked). Shared by the BERT MLM and GPT LM objectives."""
+    logp = nd.log_softmax(logits, axis=-1)
+    valid = (labels >= 0)
+    safe_labels = nd.where(valid, labels, nd.zeros_like(labels))
+    token_loss = -nd.pick(logp, safe_labels, axis=-1) * valid
+    return nd.sum(token_loss) / (nd.sum(valid) + 1e-6)
+
+
 def bert_pretrain_loss(mlm_logits, nsp_logits, labels, nsp_labels,
                        mask_weight=None):
     """Masked-LM + NSP cross entropy. labels: (N, T) with -1 for unmasked."""
-    logp = nd.log_softmax(mlm_logits, axis=-1)
-    valid = (labels >= 0)
-    safe_labels = nd.where(valid, labels,
-                           nd.zeros_like(labels))
-    token_loss = -nd.pick(logp, safe_labels, axis=-1)
-    token_loss = token_loss * valid
-    denom = nd.sum(valid) + 1e-6
-    mlm_loss = nd.sum(token_loss) / denom
+    mlm_loss = masked_cross_entropy(mlm_logits, labels)
     nsp_logp = nd.log_softmax(nsp_logits, axis=-1)
     nsp_loss = nd.mean(-nd.pick(nsp_logp, nsp_labels, axis=-1))
     return mlm_loss + nsp_loss

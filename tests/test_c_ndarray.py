@@ -1,0 +1,132 @@
+"""NDArray C API (src/ndarray/c_api_ndarray.cc; ref: include/mxnet/c_api.h
+MXNDArray* block). Round-trips the dmlc binary container between the C
+library and the Python serializer in both directions."""
+import ctypes
+import os
+
+import numpy as onp
+import pytest
+
+_LIB = os.path.join(os.path.dirname(__file__), os.pardir, 'mxnet_tpu',
+                    '_lib', 'libmxtpu_ndarray.so')
+
+
+@pytest.fixture(scope='module')
+def lib():
+    if not os.path.exists(_LIB):
+        import subprocess
+        src = os.path.join(os.path.dirname(_LIB), os.pardir, os.pardir,
+                           'src')
+        subprocess.run(['make'], cwd=src, check=False)
+    if not os.path.exists(_LIB):
+        pytest.skip("native ndarray library not built")
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArrayCreate.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXNDArrayGetShape.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    return lib
+
+
+def _make(lib, arr):
+    shape = (ctypes.c_uint32 * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    flag = {'float32': 0, 'float64': 1, 'uint8': 3, 'int32': 4,
+            'int64': 6}[arr.dtype.name]
+    assert lib.MXNDArrayCreate(shape, arr.ndim, 1, 0, 0, flag,
+                               ctypes.byref(h)) == 0
+    c = onp.ascontiguousarray(arr)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, c.ctypes.data_as(ctypes.c_void_p), c.size) == 0
+    return h
+
+
+def test_version_and_create(lib):
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0 and v.value >= 20000
+    a = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    h = _make(lib, a)
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_int64)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert ndim.value == 2 and [pdata[i] for i in range(2)] == [3, 4]
+    back = onp.zeros_like(a)
+    assert lib.MXNDArraySyncCopyToCPU(
+        h, back.ctypes.data_as(ctypes.c_void_p), back.size) == 0
+    assert onp.array_equal(back, a)
+    assert lib.MXNDArrayFree(h) == 0
+
+
+def test_c_save_python_load(lib, tmp_path):
+    from mxnet_tpu import nd
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    b = onp.arange(4, dtype=onp.int64)
+    ha, hb = _make(lib, a), _make(lib, b)
+    handles = (ctypes.c_void_p * 2)(ha, hb)
+    keys = (ctypes.c_char_p * 2)(b'weight', b'bias')
+    fname = str(tmp_path / 'c_written.params').encode()
+    assert lib.MXNDArraySave(fname, 2, handles, keys) == 0, \
+        lib.MXGetLastError()
+    loaded = nd.load(fname.decode())
+    assert set(loaded) == {'weight', 'bias'}
+    assert onp.array_equal(loaded['weight'].asnumpy(), a)
+    assert onp.array_equal(loaded['bias'].asnumpy(), b)
+    lib.MXNDArrayFree(ha)
+    lib.MXNDArrayFree(hb)
+
+
+def test_python_save_c_load(lib, tmp_path):
+    from mxnet_tpu import nd
+    fname = str(tmp_path / 'py_written.params')
+    nd.save(fname, {'w': nd.array(onp.ones((4, 2), onp.float32) * 3),
+                    'b': nd.array(onp.arange(5, dtype=onp.int32))})
+    n = ctypes.c_uint32()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    nn = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname.encode(), ctypes.byref(n),
+                             ctypes.byref(arrs), ctypes.byref(nn),
+                             ctypes.byref(names)) == 0, lib.MXGetLastError()
+    assert n.value == 2 and nn.value == 2
+    got = {}
+    for i in range(n.value):
+        h = ctypes.c_void_p(arrs[i])
+        ndim = ctypes.c_uint32()
+        pdata = ctypes.POINTER(ctypes.c_int64)()
+        lib.MXNDArrayGetShape(h, ctypes.byref(ndim), ctypes.byref(pdata))
+        shape = tuple(pdata[j] for j in range(ndim.value))
+        dt = ctypes.c_int()
+        lib.MXNDArrayGetDType(h, ctypes.byref(dt))
+        np_dt = {0: onp.float32, 4: onp.int32}[dt.value]
+        out = onp.zeros(shape, np_dt)
+        lib.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p), out.size)
+        got[names[i].decode()] = out
+        lib.MXNDArrayFree(h)
+    lib.MXNDArrayListFree(n, arrs, nn, names)
+    assert onp.allclose(got['w'], 3.0) and got['w'].shape == (4, 2)
+    assert onp.array_equal(got['b'], onp.arange(5))
+
+
+def test_error_paths(lib, tmp_path):
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 1)(3)
+    assert lib.MXNDArrayCreate(shape, 1, 1, 0, 0, 99,
+                               ctypes.byref(h)) == -1
+    assert b'dtype' in lib.MXGetLastError()
+    n = ctypes.c_uint32()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    nn = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    bad = str(tmp_path / 'nope.params').encode()
+    assert lib.MXNDArrayLoad(bad, ctypes.byref(n), ctypes.byref(arrs),
+                             ctypes.byref(nn), ctypes.byref(names)) == -1
