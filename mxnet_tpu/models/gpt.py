@@ -17,6 +17,7 @@ from ..gluon.block import HybridBlock
 from .. import ndarray as nd
 from ..ops import attention as attn_ops
 from ..ndarray.ndarray import _invoke
+from .bert import masked_cross_entropy
 
 
 def gpt2_small_config():
@@ -91,5 +92,4 @@ class GPTModel(HybridBlock):
 
 def gpt_lm_loss(logits, labels):
     """Next-token cross entropy; labels = tokens shifted left, -1 pads."""
-    from .bert import masked_cross_entropy
     return masked_cross_entropy(logits, labels)

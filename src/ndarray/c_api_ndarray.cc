@@ -22,6 +22,8 @@ thread_local std::string g_last_error;
 
 void set_error(const std::string &msg) { g_last_error = msg; }
 
+void clear_error() { g_last_error.clear(); }
+
 constexpr uint64_t kListMagic = 0x112;
 constexpr uint32_t kV2Magic = 0xF993FAC9;
 constexpr uint32_t kV3Magic = 0xF993FACA;
@@ -162,6 +164,7 @@ int MXNotifyShutdown() { return 0; }
 int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim, int dev_type,
                     int dev_id, int delay_alloc, int dtype,
                     NDArrayHandle *out) {
+  clear_error();
   (void)dev_type; (void)dev_id; (void)delay_alloc;
   if (dtype_size(dtype) < 0) {
     set_error("unknown dtype flag " + std::to_string(dtype));
@@ -212,6 +215,7 @@ int MXNDArrayGetData(NDArrayHandle handle, void **out) {
 
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t size) {
+  clear_error();
   Tensor *t = static_cast<Tensor *>(handle);
   size_t bytes = size * dtype_size(t->dtype);
   if (bytes != t->data.size()) {
@@ -223,6 +227,7 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
 }
 
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  clear_error();
   Tensor *t = static_cast<Tensor *>(handle);
   size_t bytes = size * dtype_size(t->dtype);
   if (bytes != t->data.size()) {
@@ -235,6 +240,7 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
 
 int MXNDArraySave(const char *fname, uint32_t num_args,
                   NDArrayHandle *args, const char **keys) try {
+  clear_error();
   FILE *f = fopen(fname, "wb");
   if (!f) {
     set_error(std::string("cannot open ") + fname);
@@ -251,7 +257,9 @@ int MXNDArraySave(const char *fname, uint32_t num_args,
     uint64_t len = std::strlen(keys[i]);
     ok = write_all(f, &len, 8) && write_all(f, keys[i], len);
   }
-  fclose(f);
+  /* buffered writes surface ENOSPC at flush time — fclose failing means
+   * the file on disk is NOT the file we think we wrote */
+  ok = (fclose(f) == 0) && ok;
   if (!ok) set_error("write failed");
   return ok ? 0 : -1;
 } catch (const std::exception &e) {
@@ -267,6 +275,7 @@ int MXNDArrayIsNone(NDArrayHandle handle, int *out) {
 int MXNDArrayLoad(const char *fname, uint32_t *out_size,
                   NDArrayHandle **out_arr, uint32_t *out_name_size,
                   const char ***out_names) try {
+  clear_error();
   FILE *f = fopen(fname, "rb");
   if (!f) {
     set_error(std::string("cannot open ") + fname);
@@ -281,11 +290,24 @@ int MXNDArrayLoad(const char *fname, uint32_t *out_size,
   }
   std::vector<Tensor *> arrays;
   bool ok = true;
-  for (uint64_t i = 0; ok && i < n; ++i) {
-    Tensor *t = new Tensor();
-    ok = read_tensor(f, t);
-    if (ok) arrays.push_back(t);
-    else delete t;
+  try {
+    for (uint64_t i = 0; ok && i < n; ++i) {
+      Tensor *t = new Tensor();
+      try {
+        ok = read_tensor(f, t);
+      } catch (...) {
+        delete t;
+        throw;
+      }
+      if (ok) arrays.push_back(t);
+      else delete t;
+    }
+  } catch (...) {
+    /* allocation failures (corrupt sizes) must not leak the file handle
+     * or the tensors read so far */
+    for (Tensor *t : arrays) delete t;
+    fclose(f);
+    throw;  /* function-level catch converts to -1 */
   }
   uint64_t m = 0;
   std::vector<std::string> names;
