@@ -82,14 +82,19 @@ def _adam_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.0):
     return p - lr * mhat / (jnp.sqrt(vhat) + eps), (m, v, t)
 
 
-def _adamw_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01):
+def _adamw_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01,
+                  eta=1.0):
+    # reference semantics (src/operator/contrib/adamw.cc, the GluonNLP
+    # BERTAdam recipe): NO bias correction, decoupled wd scaled by lr —
+    # kept identical to ops/optimizer_ops.py adamw_update so the Trainer
+    # and ShardedTrainStep paths produce the same trajectory
+    # (tests/test_gradients.py parity check)
     m, v, t = s
     t = t + 1
     m = beta1 * m + (1 - beta1) * g
     v = beta2 * v + (1 - beta2) * jnp.square(g)
-    mhat = m / (1 - beta1 ** t.astype(jnp.float32))
-    vhat = v / (1 - beta2 ** t.astype(jnp.float32))
-    return p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p), (m, v, t)
+    return p - eta * (lr * m / (jnp.sqrt(v) + eps) + wd * lr * p), \
+        (m, v, t)
 
 
 def _lamb_update(p, g, s, lr, beta1=0.9, beta2=0.999, eps=1e-6, wd=0.01):

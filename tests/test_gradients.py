@@ -1,0 +1,121 @@
+"""Systematic finite-difference gradient checks for the NN operator
+family (SURVEY §4; ref: tests/python/unittest/test_operator.py's
+check_numeric_gradient usage), plus parity between the two optimizer
+implementations (optimizer classes vs the fused-step _OPTS kernels)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _r(*shape, scale=0.5, seed=0):
+    return (onp.random.RandomState(seed).randn(*shape) * scale
+            ).astype(onp.float32)
+
+
+def test_convolution_gradients():
+    data, w, b = _r(1, 2, 5, 5), _r(3, 2, 3, 3, seed=1), _r(3, seed=2)
+    check_numeric_gradient(
+        lambda d, w, b: nd.convolution(d, w, b, kernel=(3, 3),
+                                       num_filter=3).sum(),
+        [data, w, b], eps=1e-3, rtol=2e-2, atol=1e-3)
+
+
+def test_pooling_gradients():
+    data = _r(1, 2, 6, 6)
+    for pool_type in ('max', 'avg'):
+        check_numeric_gradient(
+            lambda d, pt=pool_type: (nd.pooling(
+                d, kernel=(2, 2), stride=(2, 2), pool_type=pt)
+                * nd.array(_r(1, 2, 3, 3, seed=3))).sum(),
+            [data], eps=1e-3, rtol=2e-2, atol=1e-3)
+
+
+def test_layer_norm_gradients():
+    data, g, b = _r(3, 8), onp.abs(_r(8, seed=1)) + 0.5, _r(8, seed=2)
+    check_numeric_gradient(
+        lambda d, g, b: (nd.layer_norm(d, g, b)
+                         * nd.array(_r(3, 8, seed=4))).sum(),
+        [data, g, b], eps=1e-3, rtol=3e-2, atol=2e-3)
+
+
+def test_batch_norm_inference_gradients():
+    data = _r(4, 3)
+    g = onp.abs(_r(3, seed=1)) + 0.5
+    b = _r(3, seed=2)
+    mean = _r(3, seed=5) * 0.1
+    var = onp.abs(_r(3, seed=6)) + 1.0
+    check_numeric_gradient(
+        lambda d, g, b: (nd.batch_norm(
+            d, g, b, nd.array(mean), nd.array(var),
+            fix_gamma=False, use_global_stats=True)[0]
+            * nd.array(_r(4, 3, seed=7))).sum(),
+        [data, g, b], eps=1e-3, rtol=3e-2, atol=2e-3)
+
+
+def test_softmax_and_log_softmax_gradients():
+    data = _r(4, 6)
+    check_numeric_gradient(
+        lambda d: (nd.softmax(d, axis=-1)
+                   * nd.array(_r(4, 6, seed=8))).sum(),
+        [data], eps=1e-3, rtol=2e-2, atol=1e-3)
+    check_numeric_gradient(
+        lambda d: (nd.log_softmax(d, axis=-1)
+                   * nd.array(_r(4, 6, seed=9))).sum(),
+        [data], eps=1e-3, rtol=2e-2, atol=1e-3)
+
+
+def test_fused_mha_gradients():
+    q, k, v = _r(2, 6, 8), _r(2, 6, 8, seed=1), _r(2, 6, 8, seed=2)
+    from mxnet_tpu.ndarray.ndarray import _invoke
+    from mxnet_tpu.ops import attention as attn_ops
+    check_numeric_gradient(
+        lambda q, k, v: (_invoke(attn_ops.multi_head_attention, q, k, v,
+                                 None, num_heads=2, use_pallas=False)
+                         * nd.array(_r(2, 6, 8, seed=3))).sum(),
+        [q, k, v], eps=1e-3, rtol=3e-2, atol=2e-3)
+
+
+def test_optimizer_class_vs_fused_step_kernels():
+    """The optimizer CLASSES (optimizer/optimizer.py, used by Trainer)
+    and the fused-step kernels (parallel/step.py _OPTS, used by
+    ShardedTrainStep) are independent implementations of the same math —
+    they must produce the same trajectories."""
+    import jax.numpy as jnp
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.parallel import step as step_mod
+
+    cases = [
+        ('sgd', {'learning_rate': 0.05, 'momentum': 0.9, 'wd': 0.0},
+         {'momentum': 0.9, 'wd': 0.0}),
+        ('adam', {'learning_rate': 1e-2, 'wd': 0.0}, {'wd': 0.0}),
+        ('adamw', {'learning_rate': 1e-2, 'wd': 0.01}, {'wd': 0.01}),
+        ('lamb', {'learning_rate': 1e-2, 'wd': 0.01}, {'wd': 0.01}),
+    ]
+    for name, cls_kwargs, step_kwargs in cases:
+        rng = onp.random.RandomState(0)
+        w0 = rng.randn(4, 3).astype(onp.float32)
+        grads = [rng.randn(4, 3).astype(onp.float32) * 0.1
+                 for _ in range(5)]
+
+        # class path
+        o = opt_mod.create(name, **cls_kwargs)
+        w_cls = nd.array(w0.copy())
+        state = o.create_state_multi_precision(0, w_cls)
+        for g in grads:
+            o.update_multi_precision(0, w_cls, nd.array(g), state)
+
+        # fused-step kernel path
+        init_fn, update_fn = step_mod._OPTS[name]
+        p = jnp.asarray(w0.copy())
+        s = init_fn(p)
+        lr = cls_kwargs['learning_rate']
+        for g in grads:
+            p, s = update_fn(p, jnp.asarray(g), s, lr, **step_kwargs)
+
+        onp.testing.assert_allclose(
+            w_cls.asnumpy(), onp.asarray(p), rtol=1e-5, atol=1e-6,
+            err_msg=f"{name}: Trainer-class and fused-step kernels "
+                    f"diverge")
