@@ -111,7 +111,33 @@ _pallas_fallback_warned = [False]
 # multi_head_attention picks a path (once per trace, not per step — jit
 # caches the traced program). Lets benches/tests assert the flagship
 # config really routes through the flash kernel.
-route_counts = {'pallas': 0, 'xla': 0}
+route_counts = {'pallas': 0, 'xla': 0, 'ring': 0}
+
+# active sequence-parallel config: (mesh, axis) or None
+_seq_parallel = []
+
+
+class sequence_parallel:
+    """Context manager routing `multi_head_attention` through ring
+    attention over `mesh`'s `axis` — transparent long-context support:
+    models keep calling the fused op, the sequence dimension shards over
+    the mesh and K/V blocks rotate on ICI neighbor links
+    (parallel/ring_attention.py; no reference equivalent — it bucketed
+    long sequences instead).
+
+        with mx.ops.attention.sequence_parallel(mesh, 'sp'):
+            out = model(tokens)          # attention is now ring attention
+    """
+
+    def __init__(self, mesh, axis='sp'):
+        self._cfg = (mesh, axis)
+
+    def __enter__(self):
+        _seq_parallel.append(self._cfg)
+        return self
+
+    def __exit__(self, *exc):
+        _seq_parallel.pop()
 
 
 @_reg
@@ -150,14 +176,39 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
     apply_dropout = dropout_p > 0.0 and (dropout_key is not None
                                          or _flags.is_training)
 
+    # key-padding-mask normalization shared by the ring and Pallas
+    # routes: (N, Tk), boolean truthy-keep (floating stays additive)
+    kpm = _as_key_padding_mask(mask, N, k.shape[2])
+    if kpm is not None and not jnp.issubdtype(kpm.dtype, jnp.floating):
+        kpm = kpm.astype(jnp.bool_)
+
+    if _seq_parallel:
+        Tk = k.shape[2]
+        routable = (not apply_dropout and Tq == Tk
+                    and (mask is None or kpm is not None))
+        sp_mesh, sp_axis = _seq_parallel[-1]
+        if routable and Tq % sp_mesh.shape[sp_axis] != 0:
+            routable = False
+        if routable:
+            from ..parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, sp_mesh, sp_axis=sp_axis,
+                                 causal=causal, key_mask=kpm)
+            route_counts['ring'] += 1
+            return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
+        # inside the context but unroutable (dropout active, cross
+        # attention, per-query mask, indivisible T): fall through to the
+        # dense path — loudly, because the user asked for ring attention
+        import warnings
+        reason = 'attention dropout is active' if apply_dropout else             'cross-attention / per-query mask / sequence length not '             'divisible by the sp axis'
+        warnings.warn(
+            f"sequence_parallel: falling back to dense attention "
+            f"({reason}); the T x T score tensor will be materialized.",
+            RuntimeWarning)
+
     if use_pallas in ('auto', True):
         from .pallas_attention import flash_attention, pallas_available
-        kpm = _as_key_padding_mask(mask, N, k.shape[2])
         if (use_pallas is True or pallas_available()) and \
                 (mask is None or kpm is not None):
-            if kpm is not None and not jnp.issubdtype(kpm.dtype,
-                                                      jnp.floating):
-                kpm = kpm.astype(jnp.bool_)  # truthy = keep
             try:
                 if apply_dropout:
                     key_ = dropout_key if dropout_key is not None \

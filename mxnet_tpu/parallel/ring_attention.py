@@ -22,14 +22,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 
-def _block_attn(q, k, v, scale, causal, q_offset, kv_offset):
+def _block_attn(q, k, v, scale, causal, q_offset, kv_offset, kmask=None):
     """One block's contribution: returns (out_unnorm, row_max, row_sumexp).
 
     q: (B, H, Tq, D), k/v: (B, H, Tk, D). Offsets locate the blocks in the
-    global sequence for causal masking.
+    global sequence for causal masking. kmask: optional (B, Tk) additive
+    f32 key mask for the CURRENT kv block (rotates with k/v).
     """
     scores = jnp.einsum('bhqd,bhkd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    if kmask is not None:
+        scores = scores + kmask[:, None, None, :]
     if causal:
         Tq, Tk = q.shape[2], k.shape[2]
         q_pos = q_offset + jnp.arange(Tq)
@@ -55,11 +58,14 @@ def _merge(acc_out, acc_m, acc_l, out, m, l):
 
 
 def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
-                   scale=None):
+                   scale=None, key_mask=None):
     """Sequence-parallel attention.
 
-    q/k/v: (B, H, T, D) jax arrays (global logical shapes); T must divide by
-    the sp axis size. Returns (B, H, T, D) with the same sharding.
+    q/k/v: (B, H, T, D) jax arrays (global logical shapes); T must divide
+    by the sp axis size. key_mask: optional (B, T) mask over keys —
+    boolean (True = keep) or additive f32 (0 keep / large-negative drop);
+    it is sharded along the sequence axis and rotates around the ring
+    with its K/V block. Returns (B, H, T, D) with the same sharding.
     """
     B, H, T, D = q.shape
     n = mesh.shape[sp_axis]
@@ -68,8 +74,15 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
     Tl = T // n
 
     spec = P(None, None, sp_axis, None)
+    mspec = P(None, sp_axis)
+    if key_mask is not None:
+        # framework-wide convention: boolean/INTEGER masks are keep/drop
+        # (truthy = keep); only floating masks are additive
+        if not jnp.issubdtype(key_mask.dtype, jnp.floating):
+            key_mask = jnp.where(key_mask.astype(jnp.bool_), 0.0, -1e30)
+        key_mask = key_mask.astype(jnp.float32)
 
-    def local_fn(q_blk, k_blk, v_blk):
+    def local_fn(q_blk, k_blk, v_blk, m_blk):
         idx = lax.axis_index(sp_axis)
         q_off = idx * Tl
 
@@ -89,21 +102,31 @@ def ring_attention(q, k, v, mesh: Mesh, sp_axis: str = 'sp', causal=False,
             # lax.scan (not fori_loop): the ring loop must be
             # reverse-differentiable — jax transposes the ppermute into
             # the counter-rotating ring of the backward pass
-            acc_out, acc_m, acc_l, k_cur, v_cur = carry
+            acc_out, acc_m, acc_l, k_cur, v_cur, m_cur = carry
             # block currently held came from device (idx - i) mod n
             kv_off = ((idx - i) % n) * Tl
             out, m, l = _block_attn(q_blk, k_cur, v_cur, scale, causal,
-                                    q_off, kv_off)
+                                    q_off, kv_off, m_cur)
             acc_out, acc_m, acc_l = _merge(acc_out, acc_m, acc_l,
                                            out.astype(jnp.float32), m, l)
-            # rotate K/V around the ring (ICI neighbor exchange)
+            # rotate K/V (+ their key-mask slice) around the ring
             k_next = lax.ppermute(k_cur, sp_axis, perm)
             v_next = lax.ppermute(v_cur, sp_axis, perm)
-            return (acc_out, acc_m, acc_l, k_next, v_next), None
+            m_next = None if m_cur is None else \
+                lax.ppermute(m_cur, sp_axis, perm)
+            return (acc_out, acc_m, acc_l, k_next, v_next, m_next), None
 
-        (acc_out, acc_m, acc_l, _, _), _ = lax.scan(
-            body, (acc_out, acc_m, acc_l, k_blk, v_blk), jnp.arange(n))
+        (acc_out, acc_m, acc_l, _, _, _), _ = lax.scan(
+            body, (acc_out, acc_m, acc_l, k_blk, v_blk, m_blk),
+            jnp.arange(n))
         return (acc_out / jnp.maximum(acc_l, 1e-30)).astype(q_blk.dtype)
 
-    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    if key_mask is None:
+        def local_nomask(q_blk, k_blk, v_blk):
+            return local_fn(q_blk, k_blk, v_blk, None)
+        return shard_map(local_nomask, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, mspec),
+                     out_specs=spec)(q, k, v, key_mask)
