@@ -15,8 +15,8 @@ _LIB = os.path.join(os.path.dirname(__file__), os.pardir, 'mxnet_tpu',
 def lib():
     if not os.path.exists(_LIB):
         import subprocess
-        src = os.path.join(os.path.dirname(_LIB), os.pardir, os.pardir,
-                           'src')
+        src = os.path.normpath(os.path.join(
+            os.path.dirname(_LIB), os.pardir, os.pardir, 'src'))
         subprocess.run(['make'], cwd=src, check=False)
     if not os.path.exists(_LIB):
         pytest.skip("native ndarray library not built")
