@@ -76,22 +76,48 @@ def _compiler_params():
         return {}
 
 
-def _block_sizes(BH, Tq, Tk, D, dtype):
+def _block_sizes(BH, Tq, Tk, D, dtype, kind='fwd'):
     """(G, bq, bk): head-group size and MXU/VPU-aligned seq blocks.
     Sublane minimum is 8 (f32) / 16 (bf16); lanes are 128. G amortises
-    the per-invocation kernel overhead over several batch·head slices."""
+    the per-invocation kernel overhead over several batch·head slices.
+
+    kind='bwd' sizes the backward kernels, whose per-cell stack holds
+    ~6 live (bq, bk) f32 temporaries (s, p, dp, ds, keep, pv) vs the
+    forward's ~3 — at (512, 512) blocks that alone is 6MB and the dk/dv
+    kernel blows Mosaic's 16MB scoped-VMEM stack limit, so backward
+    defaults to 256-wide blocks. Env overrides for tuning:
+    MXTPU_FA_{G,BQ,BK} (forward) and MXTPU_FA_BWD_{G,BQ,BK}."""
+    import os
+    pre = 'MXTPU_FA_BWD_' if kind == 'bwd' else 'MXTPU_FA_'
     min_sub = 16 if dtype == jnp.bfloat16 else 8
-    bq = max(min_sub, min(512, Tq))
-    bk = max(min_sub, min(512, Tk))
+    cap = 512 if kind == 'fwd' else 256
+    bq = max(min_sub, min(cap, Tq))
+    bk = max(min_sub, min(cap, Tk))
     G = 1
     for cand in (4, 8, 2):    # 4 measured best on v5e at BERT-base shape
         if BH % cand == 0:
             G = cand
             break
-    # VMEM guard: blocks + scratch + per-head score tile must fit in ~12MB
-    while G > 1 and G * (bq + 2 * bk) * D * 4 + G * bq * (D + 256) * 4 \
-            + bq * bk * 4 > 12 * 2**20:
-        G //= 2
+    bq = int(os.environ.get(pre + 'BQ', bq))
+    bk = int(os.environ.get(pre + 'BK', bk))
+    genv = os.environ.get(pre + 'G')
+    if genv is not None:
+        # clamp to a divisor of BH: a non-divisor G would leave BH % G
+        # head slices outside the grid with uninitialized outputs
+        G = max(1, min(int(genv), BH))
+        while BH % G:
+            G -= 1
+    # scoped-VMEM guard (limit 16MB): double-buffered io blocks + scratch
+    # accumulators + live (bq, bk) f32 stack temporaries, ~14MB budget.
+    # Each reduction steps to the next smaller DIVISOR of BH — a
+    # non-divisor G would leave BH % G head slices outside the grid.
+    n_tmp = 3 if kind == 'fwd' else 6
+    while G > 1 and (2 * G * (bq + 2 * bk) * D * 4
+                     + G * (bq + bk) * (D + 256) * 4
+                     + n_tmp * bq * bk * 4) > 14 * 2**20:
+        G -= 1
+        while BH % G:
+            G -= 1
     return G, bq, bk
 
 
@@ -99,17 +125,22 @@ def _block_sizes(BH, Tq, Tk, D, dtype):
 # portable counter-based dropout bits
 # ---------------------------------------------------------------------------
 
-def _dropout_keep(seed, bh, q_base, k_base, bq, bk, tk_pad, rate):
+def _dropout_keep(seed, bh, q_base, k_base, bq, bk, rate):
     """(bq, bk) float32 keep/(1-rate) multiplier for one attention block.
 
     Hash of (seed, global element id) through the murmur3 finalizer.
     uint32 arithmetic wraps identically in Mosaic, XLA and the Pallas
     interpreter, so forward and backward kernels regenerate the same
-    mask from coordinates alone — grid iteration order is irrelevant.
-    """
+    mask from coordinates alone — grid iteration order is irrelevant,
+    and the row mixing uses a CONSTANT odd multiplier (not the padded
+    key length) so the backward kernels may tile the sequence
+    differently from the forward and still reproduce bit-identical
+    masks. The odd multiplier is a bijection on uint32, so no two rows
+    ever share a whole mask row (a power-of-two stride would duplicate
+    rows every 2^32/stride queries)."""
     rows = q_base + lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
     cols = k_base + lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
-    h = rows * jnp.uint32(tk_pad) + cols
+    h = rows * jnp.uint32(0x9E3779B1) + cols
     h = h + bh.astype(jnp.uint32) * jnp.uint32(0x9e3779b9)
     h = h ^ seed
     h = h ^ (h >> jnp.uint32(16))
@@ -143,7 +174,7 @@ def _masked_scores(q, k, kmask_row, qb, kb, bq, bk, scale, causal, k_len):
 
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref,
                    o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                   scale, causal, G, bq, bk, k_len, tk_pad, dropout_p):
+                   scale, causal, G, bq, bk, k_len, dropout_p):
     """One (head-group, q-block, k-block) cell. Refs are VMEM blocks:
     q (G, bq, D), k/v (G, bk, D), kmask (G, 1, bk) additive f32,
     seed (1, 1) uint32, o (G, bq, D), lse (G, bq, 1);
@@ -172,7 +203,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref,
             bh = pl.program_id(0) * G + g
             keep = _dropout_keep(seed_ref[0, 0], jnp.uint32(bh),
                                  jnp.uint32(qb * bq), jnp.uint32(kb * bk),
-                                 bq, bk, tk_pad, dropout_p)
+                                 bq, bk, dropout_p)
             pv = p * keep
         else:
             pv = p
@@ -194,7 +225,8 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref,
 def _fa_forward(q, k, v, kmask, seed, causal, dropout_p, interpret):
     """q/k/v: (BH, T, D) flattened over batch*heads.
     kmask: (BH, Tk) additive f32 or None. seed: (1, 1) uint32.
-    Returns (out, lse) with lse (BH, Tq_pad) f32."""
+    Returns (out, lse), both sliced back to (BH, Tq[, D]) — the backward
+    re-pads them for its own (possibly different) tiling."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -216,7 +248,7 @@ def _fa_forward(q, k, v, kmask, seed, causal, dropout_p, interpret):
 
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal, G=G, bq=bq, bk=bk,
-        k_len=Tk, tk_pad=tk_pad, dropout_p=float(dropout_p))
+        k_len=Tk, dropout_p=float(dropout_p))
     out, lse = pl.pallas_call(
         kernel,
         grid=(BH // G, nq, nk),
@@ -244,6 +276,7 @@ def _fa_forward(q, k, v, kmask, seed, causal, dropout_p, interpret):
     lse = lse[..., 0]
     if pq:
         out = out[:, :Tq]
+        lse = lse[:, :Tq]
     return out, lse
 
 
@@ -253,7 +286,7 @@ def _fa_forward(q, k, v, kmask, seed, causal, dropout_p, interpret):
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref, do_ref,
                   lse_ref, delta_ref, dq_ref, dq_acc, *,
-                  scale, causal, G, bq, bk, k_len, tk_pad, dropout_p):
+                  scale, causal, G, bq, bk, k_len, dropout_p):
     """dq for one q-block, accumulated over k-blocks (grid (BH/G, nq, nk))."""
     qb = pl.program_id(1)
     kb = pl.program_id(2)
@@ -275,7 +308,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref, do_ref,
             bh = pl.program_id(0) * G + g
             keep = _dropout_keep(seed_ref[0, 0], jnp.uint32(bh),
                                  jnp.uint32(qb * bq), jnp.uint32(kb * bk),
-                                 bq, bk, tk_pad, dropout_p)
+                                 bq, bk, dropout_p)
             dp = dp * keep
         ds = p * (dp - delta_ref[g]) * scale          # (bq, bk)
         dq_acc[g] = dq_acc[g] + jax.lax.dot_general(
@@ -289,7 +322,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref, do_ref,
 
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref, do_ref,
                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                   scale, causal, G, bq, bk, k_len, tk_pad, dropout_p):
+                   scale, causal, G, bq, bk, k_len, dropout_p):
     """dk/dv for one k-block, accumulated over q-blocks
     (grid (BH/G, nk, nq): k-block is program 1, q-block is program 2)."""
     kb = pl.program_id(1)
@@ -310,7 +343,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, kmask_ref, seed_ref, do_ref,
             bh = pl.program_id(0) * G + g
             keep = _dropout_keep(seed_ref[0, 0], jnp.uint32(bh),
                                  jnp.uint32(qb * bq), jnp.uint32(kb * bk),
-                                 bq, bk, tk_pad, dropout_p)
+                                 bq, bk, dropout_p)
             pv = p * keep
         else:
             keep = None
@@ -342,13 +375,16 @@ def _fa_backward(q, k, v, kmask, seed, causal, dropout_p, interpret,
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
-    G, bq, bk = _block_sizes(BH, Tq, Tk, D, q.dtype)
+    G, bq, bk = _block_sizes(BH, Tq, Tk, D, q.dtype, kind='bwd')
     nq, nk = pl.cdiv(Tq, bq), pl.cdiv(Tk, bk)
     pq, pk = nq * bq - Tq, nk * bk - Tk
     if pq:
+        # padded q rows contribute nothing: their dO is zero, so dv += p·0
+        # and ds = p·(0 - 0) vanish; lse pads as 0 harmlessly
         q = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
         do = jnp.pad(do, ((0, 0), (0, pq), (0, 0)))
         out = jnp.pad(out, ((0, 0), (0, pq), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, pq)))
     if pk:
         k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
@@ -366,7 +402,7 @@ def _fa_backward(q, k, v, kmask, seed, causal, dropout_p, interpret,
     lse3 = lse.reshape(BH, nq * bq, 1)
 
     kw = dict(scale=scale, causal=causal, G=G, bq=bq, bk=bk, k_len=Tk,
-              tk_pad=tk_pad, dropout_p=float(dropout_p))
+              dropout_p=float(dropout_p))
     qspec_i = pl.BlockSpec((G, bq, D), lambda b, i, j: (b, i, 0))
     kspec_j = pl.BlockSpec((G, bk, D), lambda b, i, j: (b, j, 0))
     col1_i = pl.BlockSpec((G, bq, 1), lambda b, i, j: (b, i, 0))

@@ -1,0 +1,102 @@
+"""On-chip tuning harness for the flagship BERT train step.
+
+Runs ONE configuration (from env/args) of the fused ShardedTrainStep at
+BERT-base scale and prints step time + honest MFU. Used to pick the
+batch size / PRNG impl / Pallas block sizes that bench.py then pins.
+
+Usage: python tools/tune_bert_step.py [--batch 32] [--rbg] [--steps 10]
+Env: MXTPU_FA_* / MXTPU_FA_BWD_* block-size overrides (ops/pallas_attention).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--batch', type=int, default=32)
+    ap.add_argument('--seq', type=int, default=512)
+    ap.add_argument('--steps', type=int, default=10)
+    ap.add_argument('--rbg', action='store_true',
+                    help='use the rbg PRNG (cheap random bits on TPU)')
+    args = ap.parse_args()
+
+    import jax
+    if args.rbg:
+        jax.config.update('jax_default_prng_impl', 'rbg')
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, '.jax_compile_cache')
+    try:
+        jax.config.update('jax_compilation_cache_dir', cache)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+    except Exception:
+        pass
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import BertForPretraining
+    from mxnet_tpu.models.bert import bert_base_config, bert_pretrain_loss
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+
+    cfg = bert_base_config()
+    batch, seq = args.batch, args.seq
+    model = BertForPretraining(cfg)
+    model.initialize(mx.init.Normal(0.02))
+    model.cast('bfloat16')
+    devices = jax.devices()
+    mesh = make_mesh((len(devices),), ('dp',), devices=devices)
+    step = ShardedTrainStep(model, bert_pretrain_loss, 'adamw',
+                            {'learning_rate': 1e-4}, mesh=mesh)
+
+    rng = onp.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, cfg['vocab_size'], (batch, seq))
+                      .astype(onp.int32))
+    types = nd.array(onp.zeros((batch, seq), onp.int32))
+    vl = nd.array(rng.randint(seq // 2, seq + 1, (batch,)).astype(onp.int32))
+    nmask = max(8, int(0.15 * seq) // 8 * 8)
+    mpos = onp.stack([rng.choice(seq, nmask, replace=False)
+                      for _ in range(batch)]).astype(onp.int32)
+    labels = nd.array(rng.randint(0, cfg['vocab_size'], (batch, nmask))
+                      .astype(onp.int32))
+    nsp = nd.array(rng.randint(0, 2, (batch,)).astype(onp.int32))
+    inputs = [tokens, types, vl, nd.array(mpos)]
+
+    t0 = time.time()
+    v = float(step(inputs, [labels, nsp]).asnumpy())
+    print(f"compile+first: {time.time() - t0:.1f}s loss={v:.4f}", flush=True)
+    for _ in range(2):
+        step(inputs, [labels, nsp])
+    t0 = time.time()
+    for _ in range(args.steps):
+        loss = step(inputs, [labels, nsp])
+    float(loss.asnumpy())
+    dt = (time.time() - t0) / args.steps
+
+    params = model.collect_params()
+    P = sum(int(onp.prod(p.shape)) for p in params.values())
+
+    def _psize(names):
+        return sum(int(onp.prod(p.shape)) for n, p in params.items()
+                   if any(s in n for s in names))
+    P_embed = _psize(['word_embed', 'pos_embed', 'type_embed', 'embedding'])
+    P_head = _psize(['mlm_'])
+    P_pool = _psize(['pooler', 'nsp'])
+    P_body = P - P_embed - P_head - P_pool
+    toks = batch * seq
+    flops = (6 * P_body * toks + 6 * P_head * batch * nmask
+             + 6 * P_pool * batch
+             + 12 * cfg['layers'] * cfg['hidden'] * seq * toks)
+    mfu = flops / dt / 197e12 * 100
+    knobs = {k: v for k, v in os.environ.items() if 'MXTPU' in k}
+    print(f"batch={batch} rbg={args.rbg} env={knobs}")
+    print(f"step={dt * 1000:.1f}ms samples/sec={batch / dt:.1f} "
+          f"MFU={mfu:.2f}%", flush=True)
+
+
+if __name__ == '__main__':
+    main()
