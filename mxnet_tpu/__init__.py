@@ -52,6 +52,7 @@ from . import contrib
 from . import operator
 from . import torch
 from . import rtc
+from . import library
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',

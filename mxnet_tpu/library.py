@@ -1,0 +1,165 @@
+"""Runtime-loadable operator libraries (ref: python/mxnet/library.py
+MXLoadLib + include/mxnet/lib_api.h:626).
+
+`load("libmyops.so")` dlopens a shared object built against
+`src/lib_api/mxtpu_lib_api.h` (C ABI, no framework headers), enumerates
+the operators it provides, and registers each one into the framework op
+registry. The C compute function runs on the host; inside jit it is
+bridged with `jax.pure_callback`, with output shapes/dtypes resolved at
+trace time through the library's `MXTPULibOpInferShape` — the TPU
+equivalent of the reference loading FCompute kernels from an external
+`.so` without recompiling the framework.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+
+from .base import MXNetError, register_op
+
+__all__ = ['load', 'loaded_libraries']
+
+_MAX_NDIM = 8
+
+# dtype code <-> numpy (parity with the reference's mshadow type flags)
+_DTYPE_TO_NP = {0: onp.float32, 1: onp.float64, 2: onp.float16,
+                3: onp.uint8, 4: onp.int32, 5: onp.int8, 6: onp.int64}
+_NP_TO_DTYPE = {onp.dtype(v): k for k, v in _DTYPE_TO_NP.items()}
+
+
+class _MXTPUTensor(ctypes.Structure):
+    _fields_ = [('data', ctypes.c_void_p),
+                ('shape', ctypes.c_int64 * _MAX_NDIM),
+                ('ndim', ctypes.c_int32),
+                ('dtype', ctypes.c_int32)]
+
+
+def _fill_tensor(t, arr=None, shape=None, dtype=None):
+    if arr is not None:
+        shape, dtype = arr.shape, arr.dtype
+        t.data = arr.ctypes.data_as(ctypes.c_void_p)
+    else:
+        t.data = None
+    if len(shape) > _MAX_NDIM:
+        raise MXNetError(f"external op tensors support <= {_MAX_NDIM} dims")
+    t.ndim = len(shape)
+    for i, s in enumerate(shape):
+        t.shape[i] = int(s)
+    code = _NP_TO_DTYPE.get(onp.dtype(dtype))
+    if code is None:
+        raise MXNetError(f"external op: unsupported dtype {dtype}")
+    t.dtype = code
+
+
+class _ExternalLibrary:
+    """One loaded .so and its registered ops."""
+
+    def __init__(self, path):
+        self.path = os.path.abspath(path)
+        self._lib = ctypes.CDLL(self.path)
+        for sym, res in [('MXTPULibVersion', ctypes.c_int),
+                         ('MXTPULibOpCount', ctypes.c_int),
+                         ('MXTPULibOpName', ctypes.c_char_p),
+                         ('MXTPULibOpNumOutputs', ctypes.c_int),
+                         ('MXTPULibOpInferShape', ctypes.c_int),
+                         ('MXTPULibOpCompute', ctypes.c_int)]:
+            try:
+                getattr(self._lib, sym).restype = res
+            except AttributeError:
+                raise MXNetError(
+                    f"{path}: not an MXTPU op library (missing {sym})")
+        try:
+            self._lib.MXTPULibLastError.restype = ctypes.c_char_p
+            self._has_err = True
+        except AttributeError:
+            self._has_err = False
+        ver = self._lib.MXTPULibVersion()
+        if ver != 1:
+            raise MXNetError(
+                f"{path}: ABI version {ver} unsupported (expected 1)")
+        self.op_names = []
+        for idx in range(self._lib.MXTPULibOpCount()):
+            name = self._lib.MXTPULibOpName(idx).decode()
+            n_out = self._lib.MXTPULibOpNumOutputs(idx)
+            self._register(idx, name, n_out)
+            self.op_names.append(name)
+
+    def _error(self, what):
+        msg = ''
+        if self._has_err:
+            raw = self._lib.MXTPULibLastError()
+            msg = raw.decode() if raw else ''
+        return MXNetError(f"{os.path.basename(self.path)}: {what}: {msg}")
+
+    def _infer(self, idx, shapes, dtypes, n_out):
+        n_in = len(shapes)
+        ins = (_MXTPUTensor * max(n_in, 1))()
+        for i, (s, d) in enumerate(zip(shapes, dtypes)):
+            _fill_tensor(ins[i], shape=s, dtype=d)
+        outs = (_MXTPUTensor * n_out)()
+        rc = self._lib.MXTPULibOpInferShape(idx, ins, n_in, outs, n_out)
+        if rc != 0:
+            raise self._error("infer_shape failed")
+        return [(tuple(int(outs[o].shape[i]) for i in range(outs[o].ndim)),
+                 _DTYPE_TO_NP[outs[o].dtype]) for o in range(n_out)]
+
+    def _compute(self, idx, arrays, out_specs):
+        n_in = len(arrays)
+        ins = (_MXTPUTensor * max(n_in, 1))()
+        arrays = [onp.ascontiguousarray(a) for a in arrays]
+        for i, a in enumerate(arrays):
+            _fill_tensor(ins[i], arr=a)
+        results = [onp.empty(s, d) for s, d in out_specs]
+        outs = (_MXTPUTensor * len(results))()
+        for o, r in enumerate(results):
+            _fill_tensor(outs[o], arr=r)
+        rc = self._lib.MXTPULibOpCompute(idx, ins, n_in, outs, len(results))
+        if rc != 0:
+            raise self._error("compute failed")
+        return results
+
+    def _register(self, idx, name, n_out):
+        import jax
+
+        def op(*args):
+            datas = [getattr(a, '_data', a) for a in args]
+            specs = self._infer(idx, [d.shape for d in datas],
+                                [d.dtype for d in datas], n_out)
+            avals = [jax.ShapeDtypeStruct(s, d) for s, d in specs]
+
+            def host(*host_args):
+                return tuple(self._compute(
+                    idx, [onp.asarray(a) for a in host_args], specs))
+
+            outs = jax.pure_callback(host, tuple(avals), *datas)
+            return outs[0] if n_out == 1 else tuple(outs)
+
+        op.__name__ = name
+        op.__doc__ = (f"external op '{name}' from "
+                      f"{os.path.basename(self.path)} (lib_api)")
+        register_op(name, num_outputs=n_out, nograd=True)(op)
+
+
+_loaded = {}
+
+
+def load(path, verbose=True):
+    """Load an external operator library (ref: python/mxnet/library.py:load).
+    Returns the list of op names registered."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library {path} not found")
+    if path in _loaded:
+        return _loaded[path].op_names
+    lib = _ExternalLibrary(path)
+    _loaded[path] = lib
+    if verbose:
+        import logging
+        logging.info("loaded library %s: ops %s", path, lib.op_names)
+    return lib.op_names
+
+
+def loaded_libraries():
+    return {p: l.op_names for p, l in _loaded.items()}

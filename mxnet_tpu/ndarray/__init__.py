@@ -20,6 +20,27 @@ _register.populate(globals(), skip=('zeros', 'ones', 'full', 'arange',
                                     'concat', 'stack'))
 
 
+def __getattr__(name):
+    """Late-bound wrappers for ops registered AFTER import — external
+    libraries (mx.library.load, ref: python/mxnet/library.py) and other
+    runtime registrations show up as mx.nd.<op> just like built-ins.
+    The wrapper resolves the op by NAME at every call (no caching of the
+    OpDef), so re-registering an op name redirects mx.nd.<op> too."""
+    from ..base import _OP_REGISTRY
+    if name not in _OP_REGISTRY:
+        raise AttributeError(f"module 'mxnet_tpu.ndarray' has no "
+                             f"attribute {name!r}")
+
+    def wrapper(*args, **kwargs):
+        kwargs.pop('out', None)
+        kwargs.pop('name', None)
+        return imperative_invoke(name, *args, **kwargs)
+
+    wrapper.__name__ = wrapper.__qualname__ = name
+    globals()[name] = wrapper
+    return wrapper
+
+
 def Custom(*inputs, op_type=None, **kwargs):
     """Invoke a Python custom op registered via mx.operator.register
     (ref: src/operator/custom/custom.cc NNVM_REGISTER_OP(Custom))."""

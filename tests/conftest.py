@@ -26,3 +26,19 @@ def _seed():
     mx.random.seed(0)
     onp.random.seed(0)
     yield
+
+
+def build_native_lib(so_name):
+    """Path to mxnet_tpu/_lib/<so_name>, running `make` in src/ if it is
+    missing; pytest.skip when the toolchain can't produce it. Shared by
+    the native-library test modules."""
+    lib = os.path.normpath(os.path.join(
+        os.path.dirname(__file__), os.pardir, 'mxnet_tpu', '_lib', so_name))
+    if not os.path.exists(lib):
+        import subprocess
+        src = os.path.normpath(os.path.join(
+            os.path.dirname(__file__), os.pardir, 'src'))
+        subprocess.run(['make'], cwd=src, check=False)
+    if not os.path.exists(lib):
+        pytest.skip(f"native library {so_name} not built")
+    return lib

@@ -2,25 +2,16 @@
 MXNDArray* block). Round-trips the dmlc binary container between the C
 library and the Python serializer in both directions."""
 import ctypes
-import os
 
 import numpy as onp
 import pytest
 
-_LIB = os.path.join(os.path.dirname(__file__), os.pardir, 'mxnet_tpu',
-                    '_lib', 'libmxtpu_ndarray.so')
+from conftest import build_native_lib
 
 
 @pytest.fixture(scope='module')
 def lib():
-    if not os.path.exists(_LIB):
-        import subprocess
-        src = os.path.normpath(os.path.join(
-            os.path.dirname(_LIB), os.pardir, os.pardir, 'src'))
-        subprocess.run(['make'], cwd=src, check=False)
-    if not os.path.exists(_LIB):
-        pytest.skip("native ndarray library not built")
-    lib = ctypes.CDLL(_LIB)
+    lib = ctypes.CDLL(build_native_lib('libmxtpu_ndarray.so'))
     lib.MXGetLastError.restype = ctypes.c_char_p
     lib.MXNDArrayCreate.argtypes = [
         ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32, ctypes.c_int,

@@ -1,0 +1,98 @@
+"""External operator libraries (src/lib_api/mxtpu_lib_api.h; ref:
+include/mxnet/lib_api.h:626 + python/mxnet/library.py MXLoadLib):
+a .so built only against the C ABI header loads at runtime, its ops
+register into the framework registry, run eagerly and under jit."""
+import numpy as onp
+import pytest
+
+from conftest import build_native_lib
+
+
+@pytest.fixture(scope='module')
+def libpath():
+    return build_native_lib('libmxtpu_example_ops.so')
+
+
+def test_load_and_list(libpath):
+    import mxnet_tpu as mx
+    ops = mx.library.load(libpath)
+    assert set(ops) == {'my_relu', 'my_gemm', 'my_split2'}
+    assert 'my_relu' in mx.list_ops()
+    assert libpath in mx.library.loaded_libraries()
+    # idempotent
+    assert mx.library.load(libpath) == ops
+
+
+def test_external_op_eager(libpath):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    mx.library.load(libpath)
+    x = nd.array(onp.array([[-1.0, 2.0], [3.0, -4.0]], onp.float32))
+    y = nd.my_relu(x)
+    onp.testing.assert_array_equal(
+        y.asnumpy(), [[0.0, 2.0], [3.0, 0.0]])
+    # int32 path
+    xi = nd.array(onp.array([[-5, 7]], onp.int32))
+    onp.testing.assert_array_equal(nd.my_relu(xi).asnumpy(), [[0, 7]])
+
+
+def test_external_gemm_vs_numpy(libpath):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    mx.library.load(libpath)
+    rng = onp.random.RandomState(0)
+    a = rng.randn(5, 7).astype(onp.float32)
+    b = rng.randn(7, 3).astype(onp.float32)
+    out = nd.my_gemm(nd.array(a), nd.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_external_op_multi_output(libpath):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    mx.library.load(libpath)
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    lo, hi = nd.my_split2(nd.array(x))
+    onp.testing.assert_array_equal(lo.asnumpy(), x[:, :2])
+    onp.testing.assert_array_equal(hi.asnumpy(), x[:, 2:])
+    # non-4-byte dtypes exercise the element-size handling
+    for dt in (onp.float16, onp.int64):
+        xd = onp.arange(12).reshape(3, 4).astype(dt)
+        lo, hi = nd.my_split2(nd.array(xd, dtype=dt))
+        onp.testing.assert_array_equal(lo.asnumpy(), xd[:, :2])
+        onp.testing.assert_array_equal(hi.asnumpy(), xd[:, 2:])
+
+
+def test_external_op_under_jit(libpath):
+    """pure_callback bridge: the external op participates in a traced
+    program (the reference's custom-op engine-boundary crossing)."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    mx.library.load(libpath)
+    from mxnet_tpu.base import get_op
+    relu = get_op('my_relu').fn
+
+    @jax.jit
+    def f(x):
+        return relu(x * 2.0) + 1.0
+
+    x = jnp.asarray([[-3.0, 5.0]], jnp.float32)
+    onp.testing.assert_allclose(onp.asarray(f(x)), [[1.0, 11.0]])
+
+
+def test_external_op_error_surface(libpath):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+    mx.library.load(libpath)
+    with pytest.raises(MXNetError, match='my_gemm'):
+        nd.my_gemm(nd.array(onp.zeros((2, 3), onp.float32)),
+                   nd.array(onp.zeros((4, 5), onp.float32)))
+
+
+def test_load_rejects_non_library(tmp_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match='not found'):
+        mx.library.load(str(tmp_path / 'nope.so'))
