@@ -9,3 +9,4 @@ from .lenet import LeNet
 from .bert import BertModel, BertForPretraining, bert_base_config, bert_pretrain_loss
 from .transformer import TransformerEncoder, TransformerModel
 from .gpt import GPTModel, gpt_lm_loss, gpt2_small_config
+from .ssd import SSD, ssd_512, ssd_300, ssd_train_loss

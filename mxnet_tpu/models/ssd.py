@@ -1,0 +1,150 @@
+"""SSD single-shot detector — the SSD-512 verification config
+(BASELINE.json configs; ref: example/ssd/symbol/symbol_builder.py and the
+multibox ops src/operator/contrib/multibox_{prior,target,detection}.cc).
+
+TPU-first shape discipline: anchors are a compile-time constant for a
+given input size (multibox_prior runs on static feature-map shapes), the
+whole forward is hybridizable into one XLA program, and training labels
+ride as a fixed-size (B, M, 5) padded tensor so the step never recompiles.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import ndarray as nd
+from ..ndarray.ndarray import _invoke
+
+
+def _feature_block(channels, repeats, pool=True):
+    blk = nn.HybridSequential()
+    for _ in range(repeats):
+        blk.add(nn.Conv2D(channels, 3, padding=1))
+        blk.add(nn.BatchNorm())
+        blk.add(nn.Activation('relu'))
+    if pool:
+        blk.add(nn.MaxPool2D(2, strides=2))
+    return blk
+
+
+def _down_block(channels):
+    """Extra feature layer: 1x1 squeeze + 3x3 stride-2 (SSD extras)."""
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels // 2, 1))
+    blk.add(nn.BatchNorm())
+    blk.add(nn.Activation('relu'))
+    blk.add(nn.Conv2D(channels, 3, strides=2, padding=1))
+    blk.add(nn.BatchNorm())
+    blk.add(nn.Activation('relu'))
+    return blk
+
+
+# per-scale anchor sizes/ratios for the 512 config (ref:
+# example/ssd/symbol/legacy_vgg16_ssd_512.py get_symbol anchor params)
+_SSD512_SIZES = [(.07, .1025), (.15, .2121), (.3, .3674), (.45, .5196),
+                 (.6, .6708), (.75, .8216), (.9, .9721)]
+_SSD512_RATIOS = [[1, 2, .5]] + [[1, 2, .5, 3, 1. / 3]] * 5 + [[1, 2, .5]]
+
+
+class SSD(HybridBlock):
+    """Backbone + multi-scale heads. num_classes EXCLUDES background
+    (VOC=20); class predictions carry num_classes+1 channels.
+
+    The default backbone is a compact VGG-style stack; scales halve the
+    feature map down to 1x1 like the reference's 512 config.
+    """
+
+    def __init__(self, num_classes=20, image_size=512, sizes=None,
+                 ratios=None, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self._sizes = sizes or _SSD512_SIZES
+        self._ratios = ratios or _SSD512_RATIOS
+        n_scales = len(self._sizes)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix='backbone_')
+            with self.features.name_scope():
+                self.features.add(_feature_block(32, 1))
+                self.features.add(_feature_block(64, 1))
+                self.features.add(_feature_block(128, 2))
+            self.stages = nn.HybridSequential(prefix='stages_')
+            self.cls_heads = nn.HybridSequential(prefix='cls_')
+            self.loc_heads = nn.HybridSequential(prefix='loc_')
+            with self.stages.name_scope():
+                self.stages.add(_feature_block(256, 2, pool=False))
+                for _ in range(n_scales - 1):
+                    self.stages.add(_down_block(256))
+            for i in range(n_scales):
+                n_anchor = len(self._sizes[i]) + len(self._ratios[i]) - 1
+                with self.cls_heads.name_scope():
+                    self.cls_heads.add(nn.Conv2D(
+                        n_anchor * (num_classes + 1), 3, padding=1))
+                with self.loc_heads.name_scope():
+                    self.loc_heads.add(nn.Conv2D(n_anchor * 4, 3, padding=1))
+
+    def forward(self, x):
+        """x: (B, 3, S, S) -> (anchors (1, A, 4) corner,
+        cls_preds (B, num_cls+1, A), loc_preds (B, A*4))."""
+        from ..ops.contrib import multibox_prior
+        import jax.numpy as jnp
+        x = self.features(x)
+        anchors, cls_preds, loc_preds = [], [], []
+        B = x.shape[0]
+        C1 = self.num_classes + 1
+        for i, stage in enumerate(self.stages):
+            x = stage(x)
+            anc = _invoke(multibox_prior, x, sizes=tuple(self._sizes[i]),
+                          ratios=tuple(self._ratios[i]))     # (1, hw*a, 4)
+            cls = self.cls_heads[i](x)                       # (B, a*C1, h, w)
+            loc = self.loc_heads[i](x)
+            anchors.append(anc)
+            # (B, a*C1, h, w) -> (B, hw*a, C1): transpose then group
+            cls_preds.append(cls.transpose((0, 2, 3, 1))
+                             .reshape(B, -1, C1))
+            loc_preds.append(loc.transpose((0, 2, 3, 1)).reshape(B, -1))
+        anchor = nd.concat(*anchors, dim=1)
+        cls_pred = nd.concat(*cls_preds, dim=1).transpose((0, 2, 1))
+        loc_pred = nd.concat(*loc_preds, dim=1)
+        return anchor, cls_pred, loc_pred
+
+    def detect(self, x, nms_threshold=0.45, threshold=0.01, nms_topk=400):
+        """Decoded detections (B, A, 6) [cls, score, x0, y0, x1, y1]."""
+        from ..ops.detection import multibox_detection
+        anchor, cls_pred, loc_pred = self(x)
+        prob = nd.softmax(cls_pred, axis=1)
+        return _invoke(multibox_detection, prob, loc_pred, anchor,
+                       nms_threshold=nms_threshold, threshold=threshold,
+                       nms_topk=nms_topk)
+
+
+def ssd_512(num_classes=20, **kwargs):
+    """SSD-512 (BASELINE.json verification config)."""
+    return SSD(num_classes=num_classes, image_size=512, **kwargs)
+
+
+def ssd_300(num_classes=20, **kwargs):
+    """A 300-input variant with the 512 head layout minus one scale."""
+    return SSD(num_classes=num_classes, image_size=300,
+               sizes=_SSD512_SIZES[:6], ratios=_SSD512_RATIOS[:6], **kwargs)
+
+
+def ssd_train_loss(anchor, cls_pred, loc_pred, label,
+                   negative_mining_ratio=3.0):
+    """MultiBox training loss: cross entropy over mined classes + smooth-L1
+    on positive boxes, normalised by positive count (ref:
+    example/ssd/train/metric.py recipe + multibox_target.cc).
+    label: (B, M, 5) rows [cls x0 y0 x1 y1], -1-padded."""
+    from ..ops.detection import multibox_target
+    box_t, box_m, cls_t = _invoke(
+        multibox_target, anchor, label, cls_pred,
+        negative_mining_ratio=negative_mining_ratio)
+    # classification: ignore_label -1 rows drop out of the loss
+    logp = nd.log_softmax(cls_pred.transpose((0, 2, 1)), axis=-1)
+    keep = (cls_t >= 0)
+    safe = nd.where(keep, cls_t, nd.zeros_like(cls_t))
+    cls_loss = -nd.pick(logp, safe, axis=-1) * keep
+    # localization: smooth-L1 on masked offsets
+    diff = nd.abs((loc_pred - box_t) * box_m)
+    loc_loss = nd.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    n_pos = nd.sum(box_m) / 4.0 + 1e-6
+    return (nd.sum(cls_loss) + nd.sum(loc_loss)) / n_pos

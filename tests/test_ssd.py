@@ -1,0 +1,97 @@
+"""SSD detector (models/ssd.py; ref: example/ssd + multibox ops
+src/operator/contrib/multibox_{prior,target,detection}.cc): shape
+contract, hybridized parity, one fused train step, and detection output
+format."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.models import SSD, ssd_train_loss
+
+
+def _tiny_ssd(num_classes=3):
+    # two scales keep the test fast; head layout identical to ssd_512
+    return SSD(num_classes=num_classes, image_size=64,
+               sizes=[(.2, .3), (.5, .6)], ratios=[[1, 2, .5]] * 2)
+
+
+def _n_anchors(model, s=64):
+    # backbone downsamples 8x; stage 0 keeps, stage 1 halves
+    f0 = s // 8
+    shapes = [f0, f0 // 2]
+    return sum(
+        (len(model._sizes[i]) + len(model._ratios[i]) - 1) * shapes[i] ** 2
+        for i in range(2))
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, 64, 64)
+                 .astype('float32'))
+    anchor, cls_pred, loc_pred = net(x)
+    A = _n_anchors(net)
+    assert anchor.shape == (1, A, 4)
+    assert cls_pred.shape == (2, 4, A)        # num_classes+1
+    assert loc_pred.shape == (2, A * 4)
+    # anchors are normalized corner boxes
+    a = anchor.asnumpy()
+    assert a.min() > -0.6 and a.max() < 1.6
+
+
+def test_ssd_hybridize_parity():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(1).randn(1, 3, 64, 64)
+                 .astype('float32'))
+    eager = [o.asnumpy() for o in net(x)]
+    net.hybridize()
+    hybrid = [o.asnumpy() for o in net(x)]
+    for e, h in zip(eager, hybrid):
+        onp.testing.assert_allclose(e, h, rtol=2e-4, atol=2e-4)
+
+
+def test_ssd_train_step_decreases_loss():
+    rng = onp.random.RandomState(0)
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.randn(2, 3, 64, 64).astype('float32'))
+    # one gt box per image, padded to M=4 rows with -1
+    label = onp.full((2, 4, 5), -1.0, onp.float32)
+    label[0, 0] = [0, 0.1, 0.1, 0.45, 0.5]
+    label[1, 0] = [2, 0.5, 0.4, 0.9, 0.95]
+    label = nd.array(label)
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 1e-3})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            anchor, cls_pred, loc_pred = net(x)
+            loss = ssd_train_loss(anchor, cls_pred, loc_pred, label)
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert onp.isfinite(losses).all()
+    assert min(losses[-2:]) < losses[0], losses
+
+
+def test_ssd_detect_format():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(2).randn(1, 3, 64, 64)
+                 .astype('float32'))
+    det = net.detect(x, threshold=-1.0)   # keep everything
+    A = _n_anchors(net)
+    assert det.shape == (1, A, 6)
+    d = det.asnumpy()
+    kept = d[0][d[0, :, 0] >= 0]
+    # class ids in range, scores in [0, 1]
+    assert (kept[:, 0] < net.num_classes).all()
+    assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()
+
+
+def test_ssd_512_constructs():
+    from mxnet_tpu.models import ssd_512
+    net = ssd_512(num_classes=20)
+    assert len(net.stages) == 7 and len(net.cls_heads) == 7
