@@ -1,0 +1,138 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh
+'pp' axis.
+
+BEYOND the reference: MXNet's model parallelism is manual layer placement
+(`Module(group2ctxs=...)`, src/operator/cross_device_copy.cc) with no
+pipeline schedule (SURVEY §2.5 "no GPipe/1F1B anywhere"). Here pipeline
+stages are a first-class mesh axis: every device holds ONE stage's
+parameters (stacked leaves sharded over 'pp'), microbatches stream
+through the ring with `lax.ppermute` on ICI neighbor links, and the whole
+schedule — forward bubbles, steady state, drain — is a single `lax.scan`
+inside `shard_map`, so XLA sees one static program and autodiff runs
+straight through the collectives (GPipe: Huang et al. 2019; the ppermute
+ring mirrors the ring-attention pattern in ring_attention.py).
+
+Design notes (TPU-first):
+- SPMD, not MPMD: all stages run the same `stage_fn`; heterogeneous
+  models are expressed by stacking per-stage parameters (vmap-style),
+  exactly how scan-over-layers works in JAX transformer stacks.
+- The schedule runs S + M - 1 ticks for S stages / M microbatches.
+  Devices idle in the bubble ticks compute garbage that is masked out —
+  branchless, static shapes, no host control flow.
+- Gradients: `jax.grad` differentiates through the scan + ppermute
+  (transpose of ppermute is the reverse permute), yielding the standard
+  GPipe backward schedule without writing it by hand.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ['pipeline_forward', 'pipeline_loss_fn', 'stack_stage_params',
+           'split_layers_into_stages']
+
+
+def stack_stage_params(stage_param_list):
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    into one pytree whose leaves gain a leading stage axis — shard that
+    axis over 'pp' and each device holds exactly its stage's weights."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *stage_param_list)
+
+
+def split_layers_into_stages(layer_params, n_stages):
+    """Group a list of per-layer pytrees into n_stages stacked groups:
+    [L0..L3] with 2 stages -> stage leaf shape (2, 2, ...) where
+    leading axis is stage, second is layer-within-stage."""
+    n = len(layer_params)
+    assert n % n_stages == 0, (n, n_stages)
+    per = n // n_stages
+    stages = []
+    for s in range(n_stages):
+        stages.append(jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *layer_params[s * per:(s + 1) * per]))
+    return stack_stage_params(stages)
+
+
+def pipeline_forward(stage_fn, stage_params, x_microbatches, mesh,
+                     pp_axis='pp'):
+    """Run microbatches through the stage pipeline.
+
+    stage_fn(params_one_stage, x) -> y: one stage's computation; applied
+    by every device to its resident stage. With grouped layers, make
+    stage_fn itself a lax.scan over the layer axis.
+    stage_params: pytree with leading stage axis (see stack_stage_params),
+    sharded over pp_axis.
+    x_microbatches: (M, mb, ...) microbatches, replicated.
+    Returns (M, mb, ...) outputs of the LAST stage (replicated — each
+    bubble tick's garbage is dropped on the floor and outputs psum-
+    broadcast from the last stage).
+    """
+    S = mesh.shape[pp_axis]
+    M = x_microbatches.shape[0]
+    n_ticks = S + M - 1
+
+    def spmd(params, xs):
+        # params: this device's stage (leading axis stripped by shard_map
+        # to size 1) — drop it
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(pp_axis)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            held, outs = carry
+            # stage 0 injects microbatch t (clamped; bubble ticks recompute
+            # an already-sent microbatch and the result is masked later)
+            inject = xs[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(stage == 0, inject, held)
+            y = stage_fn(params, cur)
+            # last stage emits microbatch m = t - (S - 1) at tick t
+            m = t - (S - 1)
+            is_out = (stage == S - 1) & (m >= 0)
+            outs = lax.cond(
+                m >= 0,
+                lambda o: o.at[jnp.clip(m, 0, M - 1)].set(
+                    jnp.where(is_out, y, o[jnp.clip(m, 0, M - 1)])),
+                lambda o: o,
+                outs)
+            # rotate activations one stage forward
+            held = lax.ppermute(y, pp_axis, fwd_perm)
+            return (held, outs), None
+
+        held0 = jnp.zeros_like(stage_fn(params, xs[0]))
+        outs0 = jnp.zeros((M,) + held0.shape, held0.dtype)
+        (_, outs), _ = lax.scan(tick, (held0, outs0),
+                                jnp.arange(n_ticks))
+        # broadcast the last stage's collected outputs to all devices
+        # (psum works because every other stage contributes zeros)
+        outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, pp_axis)
+
+    pp_spec = P(pp_axis)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: pp_spec, stage_params),
+                  P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x_microbatches)
+
+
+def pipeline_loss_fn(stage_fn, loss_fn, mesh, pp_axis='pp'):
+    """Build loss(stage_params, x_microbatches, y_microbatches) -> scalar
+    running the pipeline forward and averaging per-microbatch losses.
+    Differentiable: jax.grad through the scan/ppermute yields the GPipe
+    backward schedule."""
+
+    def loss(stage_params, x_mb, y_mb):
+        out = pipeline_forward(stage_fn, stage_params, x_mb, mesh,
+                               pp_axis=pp_axis)
+        return jnp.mean(jax.vmap(loss_fn)(out, y_mb))
+
+    return loss
