@@ -578,3 +578,372 @@ def new_matrix_with_real_eigvals_2d(n):
     d = onp.diag(onp.random.uniform(1.0, 2.0, n))
     q, _ = onp.linalg.qr(onp.random.randn(n, n))
     return (q @ d @ q.T).astype(onp.float32)
+
+
+# ---------------------------------------------------------------------------
+# sparse generators (ref: test_utils.py rand_sparse_ndarray and the CSR
+# dataset builders used by tests/python/unittest/test_sparse_operator.py)
+# ---------------------------------------------------------------------------
+
+def _validate_csr_generation_inputs(num_rows, num_cols, density,
+                                    distribution="uniform"):
+    total = num_rows * num_cols
+    if density < 0 or density > 1:
+        raise ValueError("density must be in [0, 1]")
+    if total < 10:
+        raise ValueError("matrix is too small; csr generators need >= 10 "
+                         "elements")
+    if distribution == "powerlaw" and int(density * num_cols) < 1:
+        raise ValueError("powerlaw distribution needs at least one "
+                         "nonzero per row; raise density")
+
+
+def shuffle_csr_column_indices(csr):
+    """API-parity shim (ref: test_utils.py shuffle_csr_column_indices).
+    The reference shuffles per-row index order to exercise unsorted-index
+    kernels; this framework's CSRNDArray is dense-backed (index order is
+    canonical by construction), so there is nothing to shuffle — the
+    array is returned unchanged and unsorted-index handling is a
+    non-concern by design."""
+    return csr
+
+
+def _get_uniform_dataset_csr(num_rows, num_cols, density=0.1, dtype=None,
+                             data_init=None, shuffle_csr_indices=False):
+    """Uniformly-distributed CSR dataset (ref: test_utils.py)."""
+    dtype = dtype or default_dtype()
+    _validate_csr_generation_inputs(num_rows, num_cols, density)
+    dense = onp.random.rand(num_rows, num_cols)
+    dense = (dense < density).astype(dtype)
+    if data_init is not None:
+        dense *= data_init
+    else:
+        dense *= onp.random.rand(num_rows, num_cols).astype(dtype)
+    from .ndarray import sparse as _sp
+    csr = _sp.csr_matrix(dense, dtype=dtype)
+    if shuffle_csr_indices:
+        csr = shuffle_csr_column_indices(csr)
+    return csr
+
+
+def _get_powerlaw_dataset_csr(num_rows, num_cols, density=0.1, dtype=None):
+    """Power-law row-popularity CSR dataset (ref: test_utils.py): row i
+    has ~2x the nonzeros of row i+1 until the budget runs out."""
+    dtype = dtype or default_dtype()
+    _validate_csr_generation_inputs(num_rows, num_cols, density,
+                                    "powerlaw")
+    total_nnz = int(num_rows * num_cols * density)
+    dense = onp.zeros((num_rows, num_cols), dtype)
+    unused = total_nnz
+    nnz_row = 1
+    for i in range(num_rows):
+        n = min(unused, nnz_row, num_cols)
+        if n <= 0:
+            break
+        cols = onp.random.choice(num_cols, n, replace=False)
+        dense[i, cols] = onp.random.rand(n).astype(dtype) + 0.1
+        unused -= n
+        nnz_row *= 2
+    from .ndarray import sparse as _sp
+    return _sp.csr_matrix(dense, dtype=dtype)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution=None, data_init=None,
+                        rsp_indices=None, shuffle_csr_indices=False):
+    """Random sparse ndarray + its dense numpy value
+    (ref: test_utils.py rand_sparse_ndarray). Returns (arr, (value,...))
+    matching the reference's (arr, (data, indices...)) contract loosely:
+    the second element is the dense numpy array."""
+    density = onp.random.rand() if density is None else density
+    dtype = dtype or default_dtype()
+    distribution = distribution or "uniform"
+    from .ndarray import sparse as _sp
+    if stype == 'row_sparse':
+        dense = onp.zeros(shape, dtype)
+        if rsp_indices is not None:
+            idx = onp.asarray(rsp_indices, onp.int64)
+        else:
+            n = max(1, int(shape[0] * density))
+            idx = onp.sort(onp.random.choice(shape[0], n, replace=False))
+        dense[idx] = onp.random.rand(len(idx), *shape[1:]).astype(dtype) \
+            if len(shape) > 1 else onp.random.rand(len(idx)).astype(dtype)
+        return _sp.row_sparse_array(dense, dtype=dtype), dense
+    elif stype == 'csr':
+        assert len(shape) == 2
+        if distribution == "powerlaw":
+            csr = _get_powerlaw_dataset_csr(shape[0], shape[1],
+                                            density=density, dtype=dtype)
+        else:
+            csr = _get_uniform_dataset_csr(
+                shape[0], shape[1], density=density, dtype=dtype,
+                data_init=data_init,
+                shuffle_csr_indices=shuffle_csr_indices)
+        return csr, csr.asnumpy()
+    raise ValueError(f"unknown sparse stype {stype!r}")
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=0.5,
+                        shuffle_csr_indices=False):
+    """Sparse array with optional per-element modifier (ref:
+    test_utils.py create_sparse_array)."""
+    arr, dense = rand_sparse_ndarray(
+        shape, stype, density=density, dtype=dtype, data_init=data_init,
+        rsp_indices=rsp_indices, shuffle_csr_indices=shuffle_csr_indices)
+    if modifier_func is not None:
+        vec = onp.vectorize(modifier_func)
+        dense = onp.where(dense != 0, vec(dense).astype(dense.dtype), dense)
+        from .ndarray import sparse as _sp
+        arr = (_sp.csr_matrix(dense, dtype=dense.dtype)
+               if stype == 'csr'
+               else _sp.row_sparse_array(dense, dtype=dense.dtype))
+    return arr
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None, shuffle_csr_indices=False):
+    """Sparse array that may have zero density (all-zero array)
+    (ref: test_utils.py create_sparse_array_zd)."""
+    if density == 0:
+        from .ndarray import sparse as _sp
+        dense = onp.zeros(shape, dtype or default_dtype())
+        return (_sp.csr_matrix(dense, dtype=dense.dtype)
+                if stype == 'csr'
+                else _sp.row_sparse_array(dense, dtype=dense.dtype))
+    return create_sparse_array(shape, stype, data_init=data_init,
+                               rsp_indices=rsp_indices, dtype=dtype,
+                               modifier_func=modifier_func, density=density,
+                               shuffle_csr_indices=shuffle_csr_indices)
+
+
+# ---------------------------------------------------------------------------
+# location/shape plumbing shared by the check_symbolic_* helpers
+# (ref: test_utils.py _parse_location, checkShapes, locationError)
+# ---------------------------------------------------------------------------
+
+def _parse_location(sym, location, ctx=None, dtype=None):
+    """Normalize a list/dict of inputs into a name->NDArray dict for
+    `sym`'s arguments (ref: test_utils.py _parse_location)."""
+    assert isinstance(location, (dict, list, tuple))
+    names = sym.list_arguments() if hasattr(sym, 'list_arguments') else None
+    if isinstance(location, dict):
+        if names is not None:
+            missing = set(location) - set(names)
+            if missing:
+                raise ValueError(f"location keys {sorted(missing)} not in "
+                                 f"symbol arguments {names}")
+        return {k: array(_as_np(v)) for k, v in location.items()}
+    if names is None:
+        names = [f"arg{i}" for i in range(len(location))]
+    if len(names) != len(location):
+        raise ValueError(
+            f"expected {len(names)} inputs for arguments {names}, "
+            f"got {len(location)}")
+    return {n: array(_as_np(v)) for n, v in zip(names, location)}
+
+
+def check_shapes(expected, actual):
+    """Shape-tuple list equality with a readable error
+    (ref: test_utils.py checkShapes)."""
+    if tuple(expected) != tuple(actual):
+        raise AssertionError(f"shape mismatch: expected {expected}, "
+                             f"got {actual}")
+
+
+def location_error(expected, got, name):
+    """Standard message for input-mismatch errors
+    (ref: test_utils.py locationError)."""
+    return (f"location {name!r}: expected {expected}, got {got}")
+
+
+# ---------------------------------------------------------------------------
+# statistical checks (ref: test_utils.py chi_square_check/verify_generator)
+# ---------------------------------------------------------------------------
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square goodness-of-fit of `generator(n)` samples against
+    bucket probabilities (ref: test_utils.py chi_square_check).
+    Returns (chi2_statistic, bucket_counts)."""
+    samples = onp.asarray(generator(nsamples)).reshape(-1)
+    expected = onp.asarray(probs, onp.float64) * len(samples)
+    counts = onp.zeros(len(buckets))
+    if isinstance(buckets[0], (list, tuple)):
+        for i, (lo, hi) in enumerate(buckets):
+            counts[i] = onp.sum((samples >= lo) & (samples < hi))
+    else:
+        for i, v in enumerate(buckets):
+            counts[i] = onp.sum(samples == v)
+    chi2 = onp.sum((counts - expected) ** 2 / onp.maximum(expected, 1e-9))
+    return float(chi2), counts
+
+
+# ---------------------------------------------------------------------------
+# environment / dataset utilities (ref: test_utils.py)
+# ---------------------------------------------------------------------------
+
+def set_default_context(ctx):
+    """Set the thread default context (ref: test_utils.py
+    set_default_context) — pushes onto the same stack the
+    `with ctx:` form uses."""
+    from .context import Context
+    if not hasattr(Context._default_ctx, 'stack'):
+        Context._default_ctx.stack = []
+    Context._default_ctx.stack.append(ctx)
+
+
+def get_etol(etol=None):
+    """Permitted element-mismatch fraction (ref: test_utils.py get_etol)."""
+    return 0.0 if etol is None else etol
+
+
+def list_gpus():
+    """Indices of visible GPU/TPU accelerators (ref: test_utils.py
+    list_gpus — CUDA there, any non-CPU jax device here)."""
+    import jax
+    try:
+        return list(range(len([d for d in jax.devices()
+                               if d.platform != 'cpu'])))
+    except Exception:
+        return []
+
+
+def set_env_var(key, val, default_val=""):
+    """Set env var, returning its previous value
+    (ref: test_utils.py set_env_var)."""
+    prev = os.environ.get(key, default_val)
+    os.environ[key] = val
+    return prev
+
+
+def get_mnist(path=None):
+    """MNIST as numpy dicts. Reads the idx files from `path` (or
+    MXNET_TPU_MNIST_DIR); falls back to a deterministic synthetic set in
+    airgapped environments (ref: test_utils.py get_mnist, which
+    downloads — zero-egress images can't)."""
+    path = path or os.environ.get('MXNET_TPU_MNIST_DIR')
+    if path and os.path.exists(os.path.join(path,
+                                            'train-images-idx3-ubyte')):
+        def read_idx(p):  # pragma: no cover - needs real files
+            import struct
+            with open(p, 'rb') as f:
+                magic = struct.unpack('>I', f.read(4))[0]
+                ndim = magic & 0xFF
+                dims = struct.unpack('>' + 'I' * ndim, f.read(4 * ndim))
+                return onp.frombuffer(f.read(), onp.uint8).reshape(dims)
+        # same dtypes as the synthetic fallback: float32 images in [0,1]
+        # (jax x64 is disabled), int32 labels
+        return {
+            'train_data': (read_idx(os.path.join(
+                path, 'train-images-idx3-ubyte'))[:, None]
+                / onp.float32(255.0)).astype(onp.float32),
+            'train_label': read_idx(os.path.join(
+                path, 'train-labels-idx1-ubyte')).astype(onp.int32),
+            'test_data': (read_idx(os.path.join(
+                path, 't10k-images-idx3-ubyte'))[:, None]
+                / onp.float32(255.0)).astype(onp.float32),
+            'test_label': read_idx(os.path.join(
+                path, 't10k-labels-idx1-ubyte')).astype(onp.int32),
+        }
+    rng = onp.random.RandomState(42)
+    def synth(n):
+        labels = rng.randint(0, 10, n).astype(onp.int32)
+        imgs = rng.rand(n, 1, 28, 28).astype(onp.float32) * 0.1
+        for i, l in enumerate(labels):  # class-dependent blob
+            imgs[i, 0, l:l + 10, l:l + 10] += 0.8
+        return imgs, labels
+    td, tl = synth(1024)
+    vd, vl = synth(256)
+    return {'train_data': td, 'train_label': tl,
+            'test_data': vd, 'test_label': vl}
+
+
+def get_mnist_iterator(batch_size, input_shape=(1, 28, 28), num_parts=1,
+                       part_index=0):
+    """(train_iter, val_iter) over get_mnist; num_parts/part_index give
+    each data-parallel worker a disjoint contiguous shard of the train
+    set (ref: test_utils.py get_mnist_iterator)."""
+    from .io import NDArrayIter
+    m = get_mnist()
+    shape = (-1,) + tuple(input_shape)
+    td = m['train_data'].reshape(shape)
+    tl = m['train_label']
+    if num_parts > 1:
+        n = len(td) // num_parts
+        td = td[part_index * n:(part_index + 1) * n]
+        tl = tl[part_index * n:(part_index + 1) * n]
+    train = NDArrayIter(td, tl, batch_size, shuffle=True)
+    val = NDArrayIter(m['test_data'].reshape(shape), m['test_label'],
+                      batch_size)
+    return train, val
+
+
+def get_zip_data(data_dir, url, data_origin_name):
+    """Unpack a local zip (download step is a copy in airgapped setups;
+    ref: test_utils.py get_zip_data)."""
+    import zipfile
+    path = os.path.join(data_dir, data_origin_name)
+    if os.path.exists(path):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(data_dir)
+
+
+def get_bz2_data(data_dir, data_name, url, data_origin_name):
+    """Unpack a local .bz2 (ref: test_utils.py get_bz2_data)."""
+    import bz2
+    import shutil
+    out = os.path.join(data_dir, data_name)
+    src = os.path.join(data_dir, data_origin_name)
+    if not os.path.exists(out) and os.path.exists(src):
+        with bz2.BZ2File(src) as fin, open(out, 'wb') as fout:
+            shutil.copyfileobj(fin, fout)
+
+
+def same_symbol_structure(sym1, sym2):
+    """Whether two Symbols have the same graph structure (op sequence and
+    arity; ref: test_utils.py same_symbol_structure)."""
+    def sig(sym):
+        import json
+        g = json.loads(sym.tojson())
+        return [(n.get('op'), len(n.get('inputs', [])))
+                for n in g.get('nodes', [])]
+    return sig(sym1) == sig(sym2)
+
+
+def is_cd_run():
+    """Whether running in a continuous-delivery pipeline
+    (ref: test_utils.py is_cd_run)."""
+    return os.environ.get("CD_JOB", "0") == "1"
+
+
+def has_tvm_ops():
+    """TVM-compiled operators are never present in the TPU build — XLA is
+    the backend (ref: test_utils.py has_tvm_ops)."""
+    return False
+
+
+def is_op_runnable():
+    """Reference gate for large-tensor/TVM ops; always runnable here
+    (ref: test_utils.py is_op_runnable)."""
+    return True
+
+
+def new_matrix_with_real_eigvals_nd(n, ndim=3):
+    """Batched random matrices with real eigenvalues
+    (ref: test_utils.py new_matrix_with_real_eigvals_nd)."""
+    return onp.stack([new_matrix_with_real_eigvals_2d(n)
+                      for _ in range(ndim)])
+
+
+def new_orthonormal_matrix_2d(n):
+    """Random orthonormal matrix via QR (ref: test_utils.py)."""
+    q, _ = onp.linalg.qr(onp.random.randn(n, n))
+    return q.astype(onp.float32)
+
+
+def new_sym_matrix_with_real_eigvals_2d(n):
+    """Random symmetric matrix (real eigenvalues by construction;
+    ref: test_utils.py new_sym_matrix_with_real_eigvals_2d)."""
+    a = onp.random.randn(n, n).astype(onp.float32)
+    return (a + a.T) / 2

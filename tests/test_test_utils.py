@@ -1,0 +1,116 @@
+"""The test_utils helper library itself (ref: python/mxnet/test_utils.py,
+~95 helpers backing the reference's entire unit-test suite)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_sparse_generators():
+    arr, dense = tu.rand_sparse_ndarray((16, 10), 'csr', density=0.3)
+    assert arr.stype == 'csr'
+    onp.testing.assert_allclose(arr.asnumpy(), dense)
+    nnz_frac = (dense != 0).mean()
+    assert 0.05 < nnz_frac < 0.6
+
+    arr, dense = tu.rand_sparse_ndarray((12, 6), 'row_sparse', density=0.5)
+    assert arr.stype == 'row_sparse'
+    onp.testing.assert_allclose(arr.asnumpy(), dense)
+
+    pl, dense = tu.rand_sparse_ndarray((8, 16), 'csr', density=0.2,
+                                       distribution='powerlaw')
+    d = pl.asnumpy()
+    # powerlaw: first row populated, row nnz non-increasing after doubling
+    assert (d[0] != 0).sum() >= 1
+
+
+def test_create_sparse_array_modifier_and_zd():
+    arr = tu.create_sparse_array((10, 8), 'csr', density=0.4,
+                                 modifier_func=lambda x: 2.0)
+    d = arr.asnumpy()
+    assert set(onp.unique(d)).issubset({0.0, 2.0})
+    z = tu.create_sparse_array_zd((10, 8), 'csr', density=0)
+    assert (z.asnumpy() == 0).all()
+
+
+def test_shuffle_csr_column_indices_preserves_value():
+    arr, dense = tu.rand_sparse_ndarray((10, 12), 'csr', density=0.3)
+    shuffled = tu.shuffle_csr_column_indices(arr)
+    onp.testing.assert_allclose(shuffled.asnumpy(), dense)
+
+
+def test_chi_square_check_uniform():
+    rng = onp.random.RandomState(0)
+    chi2, counts = tu.chi_square_check(
+        lambda n: rng.randint(0, 4, n), buckets=[0, 1, 2, 3],
+        probs=[0.25] * 4, nsamples=40000)
+    assert chi2 < 20, chi2
+    assert counts.sum() == 40000
+
+
+def test_chi_square_check_interval_buckets():
+    rng = onp.random.RandomState(0)
+    chi2, _ = tu.chi_square_check(
+        lambda n: rng.rand(n), buckets=[(0, .5), (.5, 1.0)],
+        probs=[0.5, 0.5], nsamples=20000)
+    assert chi2 < 15
+
+
+def test_get_mnist_and_iterator():
+    m = tu.get_mnist()
+    assert m['train_data'].shape[1:] == (1, 28, 28)
+    assert m['train_label'].max() <= 9
+    train, val = tu.get_mnist_iterator(32)
+    batch = next(iter(train))
+    assert batch.data[0].shape == (32, 1, 28, 28)
+
+
+def test_same_symbol_structure():
+    from mxnet_tpu import sym
+    def build():
+        x = sym.Variable('x')
+        return sym.Activation(sym.FullyConnected(
+            x, num_hidden=4, name='fc'), act_type='relu')
+    assert tu.same_symbol_structure(build(), build())
+    x = sym.Variable('x')
+    other = sym.FullyConnected(x, num_hidden=4, name='fc')
+    assert not tu.same_symbol_structure(build(), other)
+
+
+def test_env_and_context_helpers():
+    prev = tu.set_env_var('MXTPU_TEST_ENV_VAR', 'yes')
+    assert tu.EnvManager is not None
+    import os
+    assert os.environ['MXTPU_TEST_ENV_VAR'] == 'yes'
+    os.environ.pop('MXTPU_TEST_ENV_VAR', None)
+    assert tu.get_etol() == 0.0 and tu.get_etol(0.1) == 0.1
+    assert tu.has_tvm_ops() is False
+    assert tu.is_op_runnable() is True
+    assert isinstance(tu.list_gpus(), list)
+    tu.set_default_context(mx.cpu(0))
+    assert tu.default_context().device_type == 'cpu'
+
+
+def test_matrix_generators():
+    m = tu.new_sym_matrix_with_real_eigvals_2d(5)
+    onp.testing.assert_allclose(m, m.T)
+    q = tu.new_orthonormal_matrix_2d(4)
+    onp.testing.assert_allclose(q @ q.T, onp.eye(4), atol=1e-5)
+    a = tu.new_matrix_with_real_eigvals_2d(4)
+    assert onp.abs(onp.linalg.eigvals(a).imag).max() < 1e-5
+    b = tu.new_matrix_with_real_eigvals_nd(3, ndim=2)
+    assert b.shape == (2, 3, 3)
+
+
+def test_parse_location_and_shapes():
+    from mxnet_tpu import sym
+    x = sym.Variable('a')
+    s = sym.sin(x)
+    loc = tu._parse_location(s, {'a': onp.ones((2, 2), onp.float32)})
+    assert set(loc) == {'a'}
+    with pytest.raises(ValueError):
+        tu._parse_location(s, {'bogus': onp.ones((2, 2))})
+    tu.check_shapes((2, 3), (2, 3))
+    with pytest.raises(AssertionError):
+        tu.check_shapes((2, 3), (3, 2))
