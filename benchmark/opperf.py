@@ -1,0 +1,196 @@
+"""Per-operator benchmark suite (ref: benchmark/opperf/ — the reference
+publishes per-op fwd/bwd latency tables, benchmark/opperf/results/
+mxnet_operator_benchmark_results_{cpu,gpu}.md; BASELINE.md row
+"Per-operator fwd/bwd latency").
+
+Registry-driven: times forward (and backward where the op is
+differentiable) for a representative profile of each operator group at
+reference-comparable shapes, compiled with jit (the deployment path), and
+emits a markdown table plus a JSON lines file.
+
+Usage:
+    python benchmark/opperf.py                 # all profiled ops
+    python benchmark/opperf.py --ops dot relu  # a subset
+    python benchmark/opperf.py --json out.jsonl --md out.md
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as onp
+
+
+def _r(*shape):
+    return onp.random.RandomState(0).randn(*shape).astype(onp.float32)
+
+
+def default_profiles():
+    """op name -> zero-arg factory returning (inputs, kwargs). Factories
+    keep startup lazy: only the selected ops' arrays are materialized.
+    Shapes follow the reference opperf defaults (1024-ish)."""
+    B, M, N, K = 32, 1024, 1024, 1024
+    img = lambda: _r(32, 3, 224, 224)
+    return {
+        # tensor/elemwise
+        'add_n': lambda: ([_r(M, N), _r(M, N)], {}),
+        'relu': lambda: ([_r(M, N)], {}),
+        'sigmoid': lambda: ([_r(M, N)], {}),
+        'tanh': lambda: ([_r(M, N)], {}),
+        'exp': lambda: ([_r(M, N)], {}),
+        'log': lambda: ([onp.abs(_r(M, N)) + 1.0], {}),
+        'sqrt': lambda: ([onp.abs(_r(M, N))], {}),
+        'square': lambda: ([_r(M, N)], {}),
+        'broadcast_add': lambda: ([_r(M, N), _r(1, N)], {}),
+        'broadcast_mul': lambda: ([_r(M, N), _r(1, N)], {}),
+        'sum': lambda: ([_r(M, N)], {}),
+        'mean': lambda: ([_r(M, N)], {}),
+        'max': lambda: ([_r(M, N)], {}),
+        'argmax': lambda: ([_r(M, N)], {'axis': 1}),
+        'dot': lambda: ([_r(M, K), _r(K, N)], {}),
+        'batch_dot': lambda: ([_r(B, 128, 128), _r(B, 128, 128)], {}),
+        'transpose': lambda: ([_r(M, N)], {}),
+        'reshape': lambda: ([_r(M, N)], {'shape': (N, M)}),
+        'slice': lambda: ([_r(M, N)], {'begin': (0, 0), 'end': (M // 2, N // 2)}),
+        'take': lambda: ([_r(M, N),
+                  onp.random.RandomState(0).randint(0, M, (256,))
+                  .astype(onp.int32)], {}),
+        'one_hot': lambda: ([onp.random.RandomState(0).randint(0, 64, (M,))
+                     .astype(onp.int32)], {'depth': 64}),
+        'topk': lambda: ([_r(M, N)], {'k': 8}),
+        'sort': lambda: ([_r(M, N)], {}),
+        'clip': lambda: ([_r(M, N)], {'a_min': -0.5, 'a_max': 0.5}),
+        'abs': lambda: ([_r(M, N)], {}),
+        'where': lambda: ([(_r(M, N) > 0), _r(M, N), _r(M, N)], {}),
+        # NN core
+        'fully_connected': lambda: ([_r(B, 1024), _r(512, 1024), _r(512)],
+                           {'num_hidden': 512}),
+        'convolution': lambda: ([img(), _r(64, 3, 3, 3), _r(64)],
+                        {'kernel': (3, 3), 'num_filter': 64,
+                         'pad': (1, 1)}),
+        'pooling': lambda: ([img()], {'kernel': (2, 2), 'stride': (2, 2),
+                            'pool_type': 'max'}),
+        'activation': lambda: ([_r(M, N)], {'act_type': 'relu'}),
+        'softmax': lambda: ([_r(B, 1000)], {}),
+        'log_softmax': lambda: ([_r(B, 1000)], {}),
+        'layer_norm': lambda: ([_r(B, 512, 768), _r(768), _r(768)], {}),
+        'batch_norm': lambda: ([_r(B, 64, 56, 56), _r(64), _r(64), _r(64),
+                       onp.abs(_r(64)) + 1.0], {}),
+        'dropout': lambda: ([_r(M, N)], {'p': 0.5}),
+        'embedding': lambda: ([onp.random.RandomState(0).randint(0, 1000, (B, 128))
+                       .astype(onp.int32), _r(1000, 256)],
+                      {'input_dim': 1000, 'output_dim': 256}),
+        # attention
+        'multi_head_attention': lambda: ([_r(B, 128, 512), _r(B, 128, 512),
+                                  _r(B, 128, 512)], {'num_heads': 8}),
+        # optimizer update ops
+        'sgd_update': lambda: ([_r(M, N), _r(M, N)], {'lr': 0.1}),
+        'adam_update': lambda: ([_r(M, N), _r(M, N), _r(M, N),
+                         onp.abs(_r(M, N))], {'lr': 0.1}),
+    }
+
+
+def bench_op(opname, inputs, kwargs, iters=20, warmup=3):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.base import get_op
+
+    opdef = get_op(opname)
+    datas = [jnp.asarray(x) for x in inputs]
+    fwd = jax.jit(lambda *a: opdef.fn(*a, **kwargs))
+
+    def _time(fn, args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for _ in range(warmup):
+        jax.block_until_ready(fwd(*datas))
+    fwd_ms = _time(fwd, datas)
+
+    bwd_ms = None
+    if not opdef.nograd:
+        try:
+            f32 = [d for d in datas
+                   if hasattr(d, 'dtype') and
+                   jnp.issubdtype(d.dtype, jnp.floating)]
+            if f32:
+                def loss(*a):
+                    out = opdef.fn(*a, **kwargs)
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    return sum(jnp.sum(o.astype(jnp.float32))
+                               for o in outs
+                               if jnp.issubdtype(o.dtype, jnp.floating))
+                argnums = tuple(i for i, d in enumerate(datas)
+                                if jnp.issubdtype(d.dtype, jnp.floating))
+                g = jax.jit(jax.grad(loss, argnums=argnums))
+                jax.block_until_ready(g(*datas))
+                bwd_ms = _time(g, datas)
+        except Exception:
+            bwd_ms = None
+    return fwd_ms, bwd_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--ops', nargs='*', default=None)
+    ap.add_argument('--iters', type=int, default=20)
+    ap.add_argument('--json', default=None)
+    ap.add_argument('--md', default=None)
+    args = ap.parse_args()
+
+    import jax
+    dev = jax.devices()[0]
+    profiles = default_profiles()
+    names = args.ops or sorted(profiles)
+    rows = []
+    for name in names:
+        if name not in profiles:
+            print(f"[opperf] no profile for {name}, skipping",
+                  file=sys.stderr)
+            continue
+        inputs, kwargs = profiles[name]()
+        try:
+            fwd_ms, bwd_ms = bench_op(name, inputs, kwargs,
+                                      iters=args.iters)
+            rows.append({'op': name, 'fwd_ms': round(fwd_ms, 4),
+                         'bwd_ms': (round(bwd_ms, 4)
+                                    if bwd_ms is not None else None)})
+            print(f"[opperf] {name}: fwd {fwd_ms:.4f}ms"
+                  + (f" bwd {bwd_ms:.4f}ms" if bwd_ms else ""),
+                  file=sys.stderr)
+        except Exception as e:
+            rows.append({'op': name, 'error': repr(e)[:200]})
+            print(f"[opperf] {name}: FAILED {e!r}", file=sys.stderr)
+
+    md = ['| Operator | Fwd (ms) | Bwd (ms) |', '|---|---|---|']
+    for r in rows:
+        if 'error' in r:
+            md.append(f"| {r['op']} | error | |")
+        else:
+            b = '' if r['bwd_ms'] is None else f"{r['bwd_ms']}"
+            md.append(f"| {r['op']} | {r['fwd_ms']} | {b} |")
+    table = '\n'.join(md)
+    header = (f"# Operator benchmark — device {dev.platform} "
+              f"({getattr(dev, 'device_kind', '?')})\n\n")
+    if args.md:
+        with open(args.md, 'w') as f:
+            f.write(header + table + '\n')
+    if args.json:
+        with open(args.json, 'w') as f:
+            for r in rows:
+                f.write(json.dumps(r) + '\n')
+    print(header + table)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,28 @@
+"""Per-op benchmark harness (benchmark/opperf.py; ref: benchmark/opperf/
+suite publishing fwd/bwd latency tables)."""
+import os
+import sys
+
+import pytest
+
+
+def test_opperf_smoke(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    'benchmark'))
+    import opperf
+    fwd, bwd = opperf.bench_op('relu', [__import__('numpy').ones(
+        (64, 64), 'float32')], {}, iters=2, warmup=1)
+    assert fwd > 0
+    assert bwd is not None and bwd > 0
+
+
+def test_opperf_profiles_resolve():
+    """Every profiled op exists in the registry (guards against op
+    renames silently breaking the published table)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    'benchmark'))
+    import opperf
+    import mxnet_tpu as mx
+    ops = set(mx.list_ops())
+    missing = [n for n in opperf.default_profiles() if n not in ops]
+    assert not missing, missing
