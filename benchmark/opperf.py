@@ -120,18 +120,16 @@ def bench_op(opname, inputs, kwargs, iters=20, warmup=3):
     bwd_ms = None
     if not opdef.nograd:
         try:
-            f32 = [d for d in datas
-                   if hasattr(d, 'dtype') and
-                   jnp.issubdtype(d.dtype, jnp.floating)]
-            if f32:
+            argnums = tuple(i for i, d in enumerate(datas)
+                            if hasattr(d, 'dtype') and
+                            jnp.issubdtype(d.dtype, jnp.floating))
+            if argnums:
                 def loss(*a):
                     out = opdef.fn(*a, **kwargs)
                     outs = out if isinstance(out, (list, tuple)) else [out]
                     return sum(jnp.sum(o.astype(jnp.float32))
                                for o in outs
                                if jnp.issubdtype(o.dtype, jnp.floating))
-                argnums = tuple(i for i, d in enumerate(datas)
-                                if jnp.issubdtype(d.dtype, jnp.floating))
                 g = jax.jit(jax.grad(loss, argnums=argnums))
                 jax.block_until_ready(g(*datas))
                 bwd_ms = _time(g, datas)

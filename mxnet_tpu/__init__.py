@@ -53,6 +53,8 @@ from . import operator
 from . import torch
 from . import rtc
 from . import library
+from . import attribute
+from .attribute import AttrScope
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',

@@ -178,6 +178,17 @@ class Module(BaseModule):
         if not isinstance(context, (list, tuple)):
             context = [context]
         self._context = list(context)
+        # group2ctxs (ref: module/module.py): dict group->Context (or a
+        # list of such dicts, one per DP context) placing symbol groups
+        # annotated via AttrScope(ctx_group=...) on specific devices
+        if isinstance(group2ctxs, dict):
+            group2ctxs = [group2ctxs] * len(self._context)
+        if group2ctxs is not None and len(group2ctxs) != len(self._context):
+            raise ValueError(
+                f"group2ctxs has {len(group2ctxs)} entries for "
+                f"{len(self._context)} contexts; pass one dict (shared) "
+                f"or one per context")
+        self._group2ctxs = group2ctxs
         self._fixed_param_names = set(fixed_param_names or [])
         self._arg_params = None
         self._aux_params = None
@@ -233,8 +244,9 @@ class Module(BaseModule):
                 inferred_shapes = _infer_missing(self._symbol, ctx_shapes)
                 ctx_shapes.update(inferred_shapes)
             req = 'null' if not for_training else grad_req
+            g2c = self._group2ctxs[i] if self._group2ctxs else None
             self._execs.append(self._symbol.simple_bind(
-                ctx, grad_req=req, **ctx_shapes))
+                ctx, grad_req=req, group2ctx=g2c, **ctx_shapes))
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

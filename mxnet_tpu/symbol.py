@@ -156,8 +156,11 @@ class Symbol:
         return ([onp.float32] * len(names), [onp.float32], [])
 
     # ---- binding ----------------------------------------------------------
-    def simple_bind(self, ctx=None, grad_req='write', **shapes):
-        """Ref: symbol.py:1507 simple_bind."""
+    def simple_bind(self, ctx=None, grad_req='write', group2ctx=None,
+                    **shapes):
+        """Ref: symbol.py:1507 simple_bind. group2ctx maps __ctx_group__
+        attr values (set via mx.AttrScope(ctx_group=...)) to Contexts for
+        manual model parallelism (ref: executor_group group2ctxs)."""
         names = self.list_arguments()
         args = {}
         for n in names:
@@ -166,7 +169,8 @@ class Symbol:
             args[n] = nd_zeros(shapes[n], ctx)
         grads = {n: nd_zeros(shapes[n], ctx) for n in names} \
             if grad_req != 'null' else {}
-        return Executor(self, args, grads, grad_req, ctx)
+        return Executor(self, args, grads, grad_req, ctx,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req='write',
              aux_states=None, **kwargs):
@@ -222,7 +226,7 @@ class _SymbolList(list):
         return super().__getitem__(key)
 
 
-def _eval_node(s, bindings, cache):
+def _eval_node(s, bindings, cache, device_map=None):
     # cache by node uid: indexed output views of one multi-output node
     # share the uid, so the op runs once; distinct nodes never collide
     # even under duplicate user-assigned names
@@ -235,11 +239,27 @@ def _eval_node(s, bindings, cache):
         out = bindings[s._name]
         cache[base_key] = out
     else:
-        in_vals = [_eval_node(i, bindings, cache) for i in s.inputs]
+        in_vals = [_eval_node(i, bindings, cache, device_map)
+                   for i in s.inputs]
         opdef = get_op(s.op)
         clean_attrs = {k: v for k, v in s.attrs.items()
                        if not k.startswith('__')}
-        out = opdef.fn(*in_vals, **clean_attrs)
+        # manual model parallelism (group2ctxs): every node executes on
+        # ITS device — the mapped group's, or the executor default for
+        # unannotated nodes — so inputs arriving from other groups are
+        # transferred first (the reference's cross_device_copy between
+        # symbol groups). Without this, eager jax raises on ops whose
+        # arguments sit committed on different devices.
+        if device_map:
+            import jax as _jax
+            grp = s.attrs.get('__ctx_group__')
+            target = device_map.get(grp) or device_map.get(None)
+            if target is not None:
+                in_vals = [_jax.device_put(v, target) if hasattr(v, 'devices')
+                           else v for v in in_vals]
+            out = opdef.fn(*in_vals, **clean_attrs)
+        else:
+            out = opdef.fn(*in_vals, **clean_attrs)
         cache[base_key] = out
     if isinstance(out, tuple):
         return out[s.out_index]
@@ -263,6 +283,8 @@ def _op_arity(opname, attrs):
 
 
 def _apply(opname, inputs, attrs, name=None):
+    from .attribute import current_attrs
+    attrs = current_attrs(attrs)
     n = _op_arity(opname, attrs)
     s = Symbol(opname, inputs, attrs, name, num_outputs=n)
     if n == 1:
@@ -273,7 +295,8 @@ def _apply(opname, inputs, attrs, name=None):
 def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         lr_mult=None, wd_mult=None, **kwargs):
     """Ref: symbol.py var/Variable."""
-    s = Symbol(None, (), attr, name)
+    from .attribute import current_attrs
+    s = Symbol(None, (), current_attrs(attr), name)
     if shape is not None:
         s.attrs['__shape__'] = shape
     return s
@@ -328,7 +351,8 @@ class Executor:
     """Compiled executor (ref: include/mxnet/executor.h:53, python
     executor.py). forward/backward each run one jitted XLA call."""
 
-    def __init__(self, symbol, args, args_grad, grad_req, ctx):
+    def __init__(self, symbol, args, args_grad, grad_req, ctx,
+                 group2ctx=None):
         self._symbol = symbol
         self.arg_dict = args
         self.grad_dict = args_grad
@@ -338,12 +362,26 @@ class Executor:
         self.outputs = []
         self._jit_fwd = None
         self._vjp = None
+        # group2ctx (manual model parallelism): resolve groups to jax
+        # devices and run the DAG EAGERLY with per-node placement — each
+        # op executes on the device its ctx_group names, and jax inserts
+        # the cross-device copies (the reference\'s per-op engine dispatch
+        # + cross_device_copy). Without groups, the whole DAG compiles to
+        # one XLA program.
+        self._group2ctx = group2ctx
+        self._device_map = None
+        if group2ctx:
+            self._device_map = {g: c.jax_device()
+                                for g, c in group2ctx.items()}
+            # unannotated nodes run on the executor's own context
+            from .context import cpu as _cpu
+            self._device_map[None] = (ctx or _cpu()).jax_device()
 
         def f(bind):
-            return _eval_node(symbol, bind, {})
+            return _eval_node(symbol, bind, {}, self._device_map)
 
         self._f = f
-        self._jit_fwd = jax.jit(f)
+        self._jit_fwd = f if self._device_map else jax.jit(f)
 
     @property
     def aux_dict(self):
@@ -391,7 +429,7 @@ class Executor:
         grads = {n: nd_zeros(new_args[n].shape, self._ctx)
                  for n in self._names} if self._grad_req != 'null' else {}
         return Executor(self._symbol, new_args, grads, self._grad_req,
-                        self._ctx)
+                        self._ctx, group2ctx=self._group2ctx)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

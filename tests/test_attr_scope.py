@@ -1,0 +1,144 @@
+"""AttrScope + group2ctxs manual model parallelism (ref:
+python/mxnet/attribute.py AttrScope; module/module.py group2ctxs;
+src/operator/cross_device_copy.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_attr_scope_attaches_dunder_attrs():
+    with mx.AttrScope(ctx_group='stage1', lr_mult='0.5'):
+        x = sym.Variable('x')
+        y = sym.sin(x)
+    z = sym.cos(y)
+    assert x.attr('__ctx_group__') == 'stage1'
+    assert y.attr('__ctx_group__') == 'stage1'
+    assert y.attr('__lr_mult__') == '0.5'
+    assert z.attr('__ctx_group__') is None
+
+
+def test_attr_scope_nesting_inner_wins():
+    with mx.AttrScope(ctx_group='outer'):
+        a = sym.Variable('a')
+        with mx.AttrScope(ctx_group='inner'):
+            b = sym.exp(a)
+        c = sym.exp(a)
+    assert a.attr('__ctx_group__') == 'outer'
+    assert b.attr('__ctx_group__') == 'inner'
+    assert c.attr('__ctx_group__') == 'outer'
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(ValueError):
+        mx.AttrScope(ctx_group=3)
+
+
+def test_group2ctx_places_outputs():
+    """Symbol groups run on their mapped devices: the executor places each
+    annotated node's output on the group's jax device (the 8-device CPU
+    mesh provides distinct devices)."""
+    import jax
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    x = sym.Variable('x')
+    with mx.AttrScope(ctx_group='dev1'):
+        w1, b1 = sym.Variable('fc1_weight'), sym.Variable('fc1_bias')
+        h = sym.FullyConnected(x, w1, b1, num_hidden=8, name='fc1')
+    with mx.AttrScope(ctx_group='dev2'):
+        w2, b2 = sym.Variable('fc2_weight'), sym.Variable('fc2_bias')
+        out = sym.FullyConnected(h, w2, b2, num_hidden=4, name='fc2')
+
+    exe = out.simple_bind(mx.cpu(0), grad_req='write',
+                          group2ctx={'dev1': mx.Context('cpu', 0),
+                                     'dev2': mx.Context('cpu', 1)},
+                          x=(2, 16), fc1_weight=(8, 16), fc1_bias=(8,),
+                          fc2_weight=(4, 8), fc2_bias=(4,))
+    rng = onp.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr._data = __import__('jax.numpy', fromlist=['asarray']).asarray(
+            rng.randn(*arr.shape).astype('float32'))
+    outs = exe.forward()
+    # final output landed on dev2's device
+    dev = list(outs[0]._data.devices())[0]
+    assert dev == devs[1], (dev, devs[1])
+    # numerics match the ungrouped executor
+    exe2 = out.simple_bind(mx.cpu(0), grad_req='write',
+                           x=(2, 16), fc1_weight=(8, 16),
+                           fc1_bias=(8,), fc2_weight=(4, 8),
+                           fc2_bias=(4,))
+    for name, arr in exe2.arg_dict.items():
+        arr._data = exe.arg_dict[name]._data
+    outs2 = exe2.forward()
+    onp.testing.assert_allclose(outs[0].asnumpy(), outs2[0].asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_merging_groups():
+    """An op consuming outputs from TWO different groups gets its inputs
+    transferred to a common device (the reference's cross_device_copy) —
+    a diamond, not just a linear chain."""
+    import jax
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices")
+    x = sym.Variable('x')
+    with mx.AttrScope(ctx_group='g1'):
+        a = sym.sin(x)
+    with mx.AttrScope(ctx_group='g2'):
+        b = sym.cos(x)
+    c = a + b   # unannotated: runs on the executor's default context
+    exe = c.simple_bind(mx.cpu(0), grad_req='null',
+                        group2ctx={'g1': mx.Context('cpu', 1),
+                                   'g2': mx.Context('cpu', 2)},
+                        x=(2, 2))
+    import jax.numpy as jnp
+    xv = onp.random.RandomState(0).randn(2, 2).astype('float32')
+    exe.arg_dict['x']._data = jnp.asarray(xv)
+    out = exe.forward()[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.sin(xv) + onp.cos(xv),
+                                rtol=1e-5, atol=1e-6)
+    assert list(out._data.devices())[0] == jax.devices()[0]
+
+
+def test_group2ctx_training_backward():
+    """Gradients flow back across the group boundary (the transpose of the
+    device transfer)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    x = sym.Variable('x')
+    with mx.AttrScope(ctx_group='dev2'):
+        y = sym.sin(x)
+    exe = y.simple_bind(mx.cpu(0), grad_req='write',
+                        group2ctx={'dev2': mx.Context('cpu', 1)},
+                        x=(3, 3))
+    import jax.numpy as jnp
+    xv = onp.random.RandomState(1).randn(3, 3).astype('float32')
+    exe.arg_dict['x']._data = jnp.asarray(xv)
+    exe.forward(is_train=True)
+    exe.backward()
+    onp.testing.assert_allclose(exe.grad_dict['x'].asnumpy(),
+                                onp.cos(xv), rtol=1e-5, atol=1e-6)
+
+
+def test_module_accepts_group2ctxs():
+    from mxnet_tpu.module import Module
+    x = sym.Variable('data')
+    with mx.AttrScope(ctx_group='g'):
+        w = sym.Variable('fc_weight', shape=(4, 8))
+        b = sym.Variable('fc_bias', shape=(4,))
+        out = sym.FullyConnected(x, w, b, num_hidden=4, name='fc')
+    mod = Module(out, data_names=('data',), label_names=None,
+                 context=mx.cpu(0),
+                 group2ctxs={'g': mx.Context('cpu', 1)})
+    mod.bind(data_shapes=[('data', (2, 8))], for_training=False)
+    mod.init_params()
+    from mxnet_tpu import nd
+    mod.forward(__import__('collections').namedtuple(
+        'Batch', ['data', 'label'])(
+            [nd.array(onp.ones((2, 8), 'float32'))], None),
+        is_train=False)
+    out_ = mod.get_outputs()[0]
+    assert out_.shape == (2, 4)
