@@ -58,7 +58,8 @@ from .attribute import AttrScope
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
-           'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError']
+           'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError',
+           'AttrScope']
 
 
 # env-var configuration applied at import (ref: the reference's

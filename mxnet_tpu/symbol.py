@@ -162,12 +162,26 @@ class Symbol:
         attr values (set via mx.AttrScope(ctx_group=...)) to Contexts for
         manual model parallelism (ref: executor_group group2ctxs)."""
         names = self.list_arguments()
+        # grouped variables allocate on their group's context so model
+        # memory is actually distributed (the reference allocates args on
+        # the group ctx) and the executor's per-node placement finds the
+        # weights already resident — no per-step re-transfer
+        arg_ctx = {n: ctx for n in names}
+        if group2ctx:
+            def visit(node):
+                if node.op is None:
+                    grp = node.attrs.get('__ctx_group__')
+                    if grp in group2ctx:
+                        arg_ctx[node._name] = group2ctx[grp]
+                for i in node.inputs:
+                    visit(i)
+            visit(self)
         args = {}
         for n in names:
             if n not in shapes:
                 raise MXNetError(f"simple_bind missing shape for {n}")
-            args[n] = nd_zeros(shapes[n], ctx)
-        grads = {n: nd_zeros(shapes[n], ctx) for n in names} \
+            args[n] = nd_zeros(shapes[n], arg_ctx[n])
+        grads = {n: nd_zeros(shapes[n], arg_ctx[n]) for n in names} \
             if grad_req != 'null' else {}
         return Executor(self, args, grads, grad_req, ctx,
                         group2ctx=group2ctx)
@@ -257,9 +271,7 @@ def _eval_node(s, bindings, cache, device_map=None):
             if target is not None:
                 in_vals = [_jax.device_put(v, target) if hasattr(v, 'devices')
                            else v for v in in_vals]
-            out = opdef.fn(*in_vals, **clean_attrs)
-        else:
-            out = opdef.fn(*in_vals, **clean_attrs)
+        out = opdef.fn(*in_vals, **clean_attrs)
         cache[base_key] = out
     if isinstance(out, tuple):
         return out[s.out_index]

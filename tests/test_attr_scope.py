@@ -40,9 +40,8 @@ def test_group2ctx_places_outputs():
     annotated node's output on the group's jax device (the 8-device CPU
     mesh provides distinct devices)."""
     import jax
-    devs = jax.devices()
-    if len(devs) < 2:
-        pytest.skip("needs >= 2 devices")
+    if len(jax.devices('cpu')) < 2:
+        pytest.skip("needs >= 2 cpu devices")
     x = sym.Variable('x')
     with mx.AttrScope(ctx_group='dev1'):
         w1, b1 = sym.Variable('fc1_weight'), sym.Variable('fc1_bias')
@@ -63,7 +62,7 @@ def test_group2ctx_places_outputs():
     outs = exe.forward()
     # final output landed on dev2's device
     dev = list(outs[0]._data.devices())[0]
-    assert dev == devs[1], (dev, devs[1])
+    assert dev == mx.Context('cpu', 1).jax_device(), dev
     # numerics match the ungrouped executor
     exe2 = out.simple_bind(mx.cpu(0), grad_req='write',
                            x=(2, 16), fc1_weight=(8, 16),
@@ -81,8 +80,8 @@ def test_group2ctx_merging_groups():
     transferred to a common device (the reference's cross_device_copy) —
     a diamond, not just a linear chain."""
     import jax
-    if len(jax.devices()) < 3:
-        pytest.skip("needs >= 3 devices")
+    if len(jax.devices('cpu')) < 3:
+        pytest.skip("needs >= 3 cpu devices")
     x = sym.Variable('x')
     with mx.AttrScope(ctx_group='g1'):
         a = sym.sin(x)
@@ -99,15 +98,15 @@ def test_group2ctx_merging_groups():
     out = exe.forward()[0]
     onp.testing.assert_allclose(out.asnumpy(), onp.sin(xv) + onp.cos(xv),
                                 rtol=1e-5, atol=1e-6)
-    assert list(out._data.devices())[0] == jax.devices()[0]
+    assert list(out._data.devices())[0] == mx.cpu(0).jax_device()
 
 
 def test_group2ctx_training_backward():
     """Gradients flow back across the group boundary (the transpose of the
     device transfer)."""
     import jax
-    if len(jax.devices()) < 2:
-        pytest.skip("needs >= 2 devices")
+    if len(jax.devices('cpu')) < 2:
+        pytest.skip("needs >= 2 cpu devices")
     x = sym.Variable('x')
     with mx.AttrScope(ctx_group='dev2'):
         y = sym.sin(x)
