@@ -350,6 +350,9 @@ def _child(mode: str) -> None:
         except Exception as e:  # flagship number still lands
             out["pallas"] = {"error": repr(e)[:300]}
             _log(f"pallas report failed: {e!r}")
+        # checkpoint the enriched line: if the resnet report overruns the
+        # child timeout, the salvaged line still carries the pallas data
+        print(json.dumps(out), flush=True)
         deadline = float(os.environ.get('BENCH_CHILD_DEADLINE', '0'))
         if deadline and time.time() > deadline - 180:
             out["resnet50"] = {"skipped": "child deadline too close"}

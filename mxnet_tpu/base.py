@@ -65,6 +65,27 @@ def list_ops():
     return sorted(_OP_REGISTRY)
 
 
+# Storage-driven kernel dispatch (ref: FComputeEx,
+# include/mxnet/op_attr_types.h:304): an op may register alternative
+# implementations keyed by the storage types of its tensor arguments;
+# the imperative invoke swaps them in when the stype signature matches.
+_SPARSE_IMPLS: Dict[tuple, Callable] = {}
+
+
+def register_sparse_impl(opname: str, stypes: tuple):
+    """Register a storage-specific implementation of `opname` for the
+    given tuple of positional-argument storage types, e.g.
+    ('csr', 'default')."""
+    def deco(fn: Callable):
+        _SPARSE_IMPLS[(opname, tuple(stypes))] = fn
+        return fn
+    return deco
+
+
+def lookup_sparse_impl(opname: str, stypes: tuple):
+    return _SPARSE_IMPLS.get((opname, tuple(stypes)))
+
+
 # ---------------------------------------------------------------------------
 # Generic string-keyed object registries (ref: python/mxnet/registry.py) used
 # by optimizers, initializers, metrics, datasets...

@@ -462,7 +462,28 @@ def _wrap(data) -> NDArray:
 
 
 def _invoke(fn, *args, **kwargs):
-    """Eager dispatch of a registered compute fn on NDArray args."""
+    """Eager dispatch of a registered compute fn on NDArray args.
+
+    Storage-driven dispatch (the reference's FComputeEx,
+    op_attr_types.h:304): when a positional argument carries a sparse
+    stype and a storage-specific implementation is registered for the
+    op's stype signature, that kernel runs instead of the dense one."""
+    stypes = tuple(getattr(a, '_stype', 'default') or 'default'
+                   for a in args if isinstance(a, NDArray))
+    if any(st != 'default' for st in stypes):
+        from ..base import lookup_sparse_impl
+        impl = lookup_sparse_impl(getattr(fn, '__name__', ''), stypes)
+        if impl is not None:
+            # eager pre-compute hook: host-side facts (e.g. the nnz
+            # budget) must come from the CONCRETE payloads here — inside
+            # invoke the args may be autograd tracers
+            prepare = getattr(impl, '__sparse_prepare__', None)
+            if prepare is not None:
+                import functools
+                fn = functools.wraps(impl)(
+                    functools.partial(impl, **prepare(args, kwargs)))
+            else:
+                fn = impl
     out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(fn, args, kwargs)
     if isinstance(out_data, tuple):
         outs = [NDArray(o) for o in out_data]

@@ -16,9 +16,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..base import register_op
+from ..base import register_op, register_sparse_impl
 
 __all__ = []
+
+# storage-dispatch telemetry: which sparse kernels actually ran
+# (trace-time counts, like ops.attention.route_counts)
+route_counts = {'dot_csr_dense': 0}
 
 
 def _reg(fn):
@@ -56,6 +60,36 @@ def dot_csr_dense(lhs, rhs, nse=None):
         nse = int(lhs.shape[0]) * int(lhs.shape[1])
     sp = jsparse.BCOO.fromdense(lhs, nse=nse)
     return sp @ rhs
+
+
+@register_sparse_impl('dot', ('csr', 'default'))
+def _dot_csr_dense_dispatch(lhs, rhs, transpose_a=False,
+                            transpose_b=False, nse=None):
+    """FComputeEx route for nd.dot(csr, dense) (ref: dot.cc
+    DotCsrDnsDnsImpl): contract through BCOO with the true nnz budget.
+    `nse` arrives from __sparse_prepare__ below, computed eagerly from
+    the concrete payload BEFORE tracing — under autograd the lhs seen
+    here is a tracer, and BCOO needs a static budget. Differentiable:
+    bcoo_dot_general carries transpose rules, so grad(W) of
+    dot(csr_x, W) works."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    if nse is None:
+        nse = int(lhs.shape[-1]) * int(lhs.shape[-2])
+    route_counts['dot_csr_dense'] += 1
+    return dot_csr_dense(lhs, rhs, nse=nse)
+
+
+def _dot_csr_prepare(args, kwargs):
+    import numpy as onp
+    lhs = args[0]
+    payload = lhs.asnumpy() if hasattr(lhs, 'asnumpy') else onp.asarray(lhs)
+    return {'nse': max(1, int(onp.count_nonzero(payload)))}
+
+
+_dot_csr_dense_dispatch.__sparse_prepare__ = _dot_csr_prepare
 
 
 @_reg

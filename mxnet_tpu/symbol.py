@@ -168,14 +168,21 @@ class Symbol:
         # weights already resident — no per-step re-transfer
         arg_ctx = {n: ctx for n in names}
         if group2ctx:
-            def visit(node):
+            # iterative walk with a seen-set: shared subgraphs (residual
+            # diamonds) visit once, and deep chains don't hit the
+            # recursion limit
+            seen = set()
+            stack = [self]
+            while stack:
+                node = stack.pop()
+                if node._uid in seen:
+                    continue
+                seen.add(node._uid)
                 if node.op is None:
                     grp = node.attrs.get('__ctx_group__')
                     if grp in group2ctx:
                         arg_ctx[node._name] = group2ctx[grp]
-                for i in node.inputs:
-                    visit(i)
-            visit(self)
+                stack.extend(node.inputs)
         args = {}
         for n in names:
             if n not in shapes:

@@ -162,3 +162,54 @@ def test_sparse_grad_is_row_sparse_ndarray():
     assert isinstance(g, sp.RowSparseNDArray)
     assert g.stype == 'row_sparse'
     assert sorted(g.indices.asnumpy().tolist()) == [0, 2]
+
+
+def test_dot_csr_dense_storage_dispatch():
+    """nd.dot with a CSR lhs routes through the BCOO sparse kernel
+    (FComputeEx storage-driven dispatch, op_attr_types.h:304) and matches
+    the dense result."""
+    import numpy as onp
+    from mxnet_tpu import nd
+    from mxnet_tpu.ndarray import sparse
+    from mxnet_tpu.ops import sparse_ops
+
+    rng = onp.random.RandomState(0)
+    dense = rng.randn(8, 6).astype('float32')
+    dense[dense < 0.5] = 0.0
+    csr = sparse.csr_matrix(dense)
+    rhs = nd.array(rng.randn(6, 4).astype('float32'))
+
+    before = sparse_ops.route_counts['dot_csr_dense']
+    out = nd.dot(csr, rhs)
+    assert sparse_ops.route_counts['dot_csr_dense'] == before + 1
+    onp.testing.assert_allclose(out.asnumpy(), dense @ rhs.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+    # dense lhs still takes the dense kernel
+    out2 = nd.dot(nd.array(dense), rhs)
+    assert sparse_ops.route_counts['dot_csr_dense'] == before + 1
+    onp.testing.assert_allclose(out2.asnumpy(), out.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_dot_csr_dense_under_autograd():
+    """The sparse route survives autograd recording: the nnz budget is
+    computed eagerly before tracing, and gradients flow to the dense
+    operand (regression: TracerArrayConversionError when counting nnz on
+    a traced array)."""
+    import numpy as onp
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray import sparse
+
+    rng = onp.random.RandomState(1)
+    dense = rng.randn(6, 5).astype('float32')
+    dense[dense < 0.6] = 0.0
+    csr = sparse.csr_matrix(dense)
+    W = nd.array(rng.randn(5, 3).astype('float32'))
+    W.attach_grad()
+    with autograd.record():
+        out = nd.dot(csr, W)
+        loss = nd.sum(out)
+    loss.backward()
+    onp.testing.assert_allclose(
+        W.grad.asnumpy(), (dense.T @ onp.ones((6, 3), 'float32')),
+        rtol=1e-5, atol=1e-5)
