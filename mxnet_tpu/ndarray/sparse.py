@@ -33,7 +33,7 @@ class BaseSparseNDArray(NDArray):
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix (ref: sparse.py:300)."""
-    __slots__ = ()
+    __slots__ = ('_nnz_cache',)  # (payload id, nnz) for sparse dispatch
 
     def __init__(self, data, ctx=None):
         super().__init__(data, ctx)

@@ -71,22 +71,42 @@ def _dot_csr_dense_dispatch(lhs, rhs, transpose_a=False,
     the concrete payload BEFORE tracing — under autograd the lhs seen
     here is a tracer, and BCOO needs a static budget. Differentiable:
     bcoo_dot_general carries transpose rules, so grad(W) of
-    dot(csr_x, W) works."""
+    dot(csr_x, W) works.
+
+    Only the 2-D x 2-D case takes the sparse path (the reference's CSR
+    dot is likewise matrix-only); anything else defers to the dense op
+    so both storages keep identical tensordot semantics."""
+    if lhs.ndim != 2 or rhs.ndim != 2:
+        from .matrix import dot as _dense_dot
+        return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
     if transpose_a:
-        lhs = jnp.swapaxes(lhs, -1, -2)
+        lhs = lhs.T
     if transpose_b:
-        rhs = jnp.swapaxes(rhs, -1, -2)
+        rhs = rhs.T
     if nse is None:
-        nse = int(lhs.shape[-1]) * int(lhs.shape[-2])
+        nse = int(lhs.shape[0]) * int(lhs.shape[1])
     route_counts['dot_csr_dense'] += 1
     return dot_csr_dense(lhs, rhs, nse=nse)
 
 
 def _dot_csr_prepare(args, kwargs):
+    """nnz budget from the CONCRETE payload, cached on the wrapper so a
+    training loop reusing one CSR matrix counts once, not per step."""
     import numpy as onp
     lhs = args[0]
+    data = getattr(lhs, '_data', None)
+    cached = getattr(lhs, '_nnz_cache', None)
+    if cached is not None and data is not None and cached[0] is data:
+        return {'nse': cached[1]}
     payload = lhs.asnumpy() if hasattr(lhs, 'asnumpy') else onp.asarray(lhs)
-    return {'nse': max(1, int(onp.count_nonzero(payload)))}
+    nse = max(1, int(onp.count_nonzero(payload)))
+    if data is not None:
+        try:
+            lhs._nnz_cache = (data, nse)
+        except AttributeError:  # __slots__ without the cache slot
+            pass
+    return {'nse': nse}
 
 
 _dot_csr_dense_dispatch.__sparse_prepare__ = _dot_csr_prepare

@@ -21,6 +21,32 @@ from .context import cpu
 from .ndarray.ndarray import NDArray, array, zeros as nd_zeros, _wrap
 
 
+def _iter_nodes(root, order='pre', key=id):
+    """Iterative DFS over the Symbol DAG, each node visited once (by
+    `key`): no RecursionError on deep chains, no exponential re-walks of
+    shared (residual/diamond) subgraphs. 'pre' yields a node before its
+    inputs; 'post' after (inputs always precede consumers in 'post')."""
+    seen = set()
+    out = []
+    stack = [(root, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            out.append(node)
+            continue
+        k = key(node)
+        if k in seen:
+            continue
+        seen.add(k)
+        if order == 'pre':
+            out.append(node)
+        else:
+            stack.append((node, True))
+        for i in reversed(node.inputs):
+            stack.append((i, False))
+    return out
+
+
 class Symbol:
     _counter = [0]
 
@@ -48,12 +74,9 @@ class Symbol:
 
     def list_arguments(self):
         seen = []
-        def visit(s):
+        for s in _iter_nodes(self, 'pre'):
             if s.op is None and s._name not in seen:
                 seen.append(s._name)
-            for i in s.inputs:
-                visit(i)
-        visit(self)
         return seen
 
     def list_outputs(self):
@@ -63,14 +86,7 @@ class Symbol:
         return []
 
     def get_internals(self):
-        nodes = []
-        def visit(s):
-            for i in s.inputs:
-                visit(i)
-            if s not in nodes:
-                nodes.append(s)
-        visit(self)
-        return _SymbolList(nodes)
+        return _SymbolList(_iter_nodes(self, 'post'))
 
     def attr(self, key):
         return self.attrs.get(key)
@@ -168,21 +184,11 @@ class Symbol:
         # weights already resident — no per-step re-transfer
         arg_ctx = {n: ctx for n in names}
         if group2ctx:
-            # iterative walk with a seen-set: shared subgraphs (residual
-            # diamonds) visit once, and deep chains don't hit the
-            # recursion limit
-            seen = set()
-            stack = [self]
-            while stack:
-                node = stack.pop()
-                if node._uid in seen:
-                    continue
-                seen.add(node._uid)
+            for node in _iter_nodes(self, 'pre', key=lambda n: n._uid):
                 if node.op is None:
                     grp = node.attrs.get('__ctx_group__')
                     if grp in group2ctx:
                         arg_ctx[node._name] = group2ctx[grp]
-                stack.extend(node.inputs)
         args = {}
         for n in names:
             if n not in shapes:
@@ -209,24 +215,21 @@ class Symbol:
         index = {}  # node uid -> node idx (indexed views share the uid)
         names = {}  # serialized name -> uid (duplicate-name guard)
 
-        def visit(s):
-            if s._uid in index:
-                return index[s._uid], s.out_index
-            in_refs = [visit(i) for i in s.inputs]
+        # postorder by uid: every node's inputs are indexed before it
+        for s in _iter_nodes(self, 'post', key=lambda n: n._uid):
+            in_refs = [(index[i._uid], i.out_index) for i in s.inputs]
             if s._name in names and names[s._name] != s._uid:
                 raise MXNetError(
                     f"duplicate node name '{s._name}' in graph; names must "
                     "be unique to serialize")
             names[s._name] = s._uid
-            idx = len(nodes)
+            index[s._uid] = len(nodes)
             nodes.append({'op': s.op or 'null', 'name': s._name,
                           'attrs': {k: str(v) for k, v in s.attrs.items()},
                           'inputs': [[i, oi, 0] for i, oi in in_refs]})
-            index[s._uid] = idx
-            return idx, s.out_index
 
-        head_idx, head_oi = visit(self)
-        return json.dumps({'nodes': nodes, 'heads': [[head_idx, head_oi, 0]],
+        return json.dumps({'nodes': nodes,
+                           'heads': [[index[self._uid], self.out_index, 0]],
                            'mxnet_tpu_version': 2}, indent=2)
 
     def save(self, fname):

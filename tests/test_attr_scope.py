@@ -141,3 +141,23 @@ def test_module_accepts_group2ctxs():
         is_train=False)
     out_ = mod.get_outputs()[0]
     assert out_.shape == (2, 4)
+
+
+def test_deep_graph_traversals_no_recursion_limit():
+    """2000-op chains and shared diamonds traverse iteratively:
+    list_arguments, get_internals, tojson and the group2ctx walk must not
+    recurse per-path (regression: RecursionError / exponential blowup)."""
+    x = sym.Variable('x0')
+    s = x
+    for _ in range(2000):
+        s = sym.sin(s)
+    assert s.list_arguments() == ['x0']
+    assert len(s.get_internals()) == 2001
+    j = s.tojson()
+    assert j.count('"sin"') == 2000
+    # diamond-heavy graph: 40 junctions would be 2^40 path-visits
+    d = sym.Variable('d')
+    for _ in range(40):
+        d = d + d
+    assert d.list_arguments() == ['d']
+    d.tojson()

@@ -1,0 +1,107 @@
+"""Thread-locality and threaded inference (ref:
+tests/nightly/test_tlocal_racecondition.py, tests/python/unittest/
+test_thread_local.py, and the thread-safe CachedOp suite
+tests/cpp/thread_safety/)."""
+import threading
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, sym
+
+
+def test_context_stack_is_thread_local():
+    results = {}
+
+    def worker(idx):
+        with mx.Context('cpu', idx):
+            import time
+            time.sleep(0.05)
+            results[idx] = mx.current_context().device_id
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: 0, 1: 1}, results
+
+
+def test_attr_scope_is_thread_local():
+    seen = {}
+
+    def worker(tag):
+        with mx.AttrScope(ctx_group=tag):
+            import time
+            time.sleep(0.05)
+            s = sym.Variable(f'v_{tag}')
+            seen[tag] = s.attr('__ctx_group__')
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in ('a', 'b')]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen == {'a': 'a', 'b': 'b'}, seen
+
+
+def test_autograd_recording_is_thread_local():
+    flags = {}
+
+    def recorder():
+        x = nd.array(onp.ones((2, 2), 'float32'))
+        x.attach_grad()
+        with autograd.record():
+            import time
+            time.sleep(0.05)
+            flags['rec'] = autograd.is_recording()
+            y = nd.sum(x * 2)
+        y.backward()
+        flags['grad'] = x.grad.asnumpy()
+
+    def bystander():
+        import time
+        time.sleep(0.02)
+        flags['other'] = autograd.is_recording()
+
+    t1 = threading.Thread(target=recorder)
+    t2 = threading.Thread(target=bystander)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert flags['rec'] is True
+    assert flags['other'] is False
+    onp.testing.assert_allclose(flags['grad'], 2 * onp.ones((2, 2)))
+
+
+def test_threadsafe_hybridized_inference():
+    """Concurrent forwards through ONE hybridized block from N threads
+    produce correct, deterministic outputs (the thread-safe CachedOp
+    contract, src/imperative/cached_op_threadsafe.cc)."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation='relu'))
+        net.add(gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    rng = onp.random.RandomState(0)
+    xs = [rng.randn(4, 16).astype('float32') for _ in range(8)]
+    expected = [net(nd.array(x)).asnumpy() for x in xs]
+
+    outs = [None] * len(xs)
+    errs = []
+
+    def worker(i):
+        try:
+            outs[i] = net(nd.array(xs[i])).asnumpy()
+        except Exception as e:  # pragma: no cover
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for got, want in zip(outs, expected):
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
