@@ -55,11 +55,20 @@ from . import rtc
 from . import library
 from . import attribute
 from .attribute import AttrScope
+from . import name
+from . import monitor
+from .monitor import Monitor
+from . import log
+from . import libinfo
+from . import registry
+from . import executor
+from . import executor_manager
+from . import kvstore_server
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',
            'kvstore', 'random', 'cpu', 'gpu', 'tpu', 'Context', 'MXNetError',
-           'AttrScope']
+           'AttrScope', 'Monitor']
 
 
 # env-var configuration applied at import (ref: the reference's

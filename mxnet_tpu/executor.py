@@ -1,0 +1,10 @@
+"""Executor module (ref: python/mxnet/executor.py): re-exports the
+Symbol executor and adds the monitor-callback surface. The executor
+itself lives in symbol.py (the DAG and its compiled evaluation are one
+design unit here); this module keeps the reference's import path
+`mx.executor.Executor` working."""
+from __future__ import annotations
+
+from .symbol import Executor  # noqa: F401
+
+__all__ = ['Executor']

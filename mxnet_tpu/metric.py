@@ -143,9 +143,11 @@ class CompositeEvalMetric(EvalMetric):
         return (names, values)
 
 
+@register(name='acc')
 @register
 class Accuracy(EvalMetric):
-    """Ref: metric.py:437."""
+    """Ref: metric.py:437 (registered under 'accuracy' and the
+    reference's 'acc' alias)."""
 
     def __init__(self, axis=1, name='accuracy', **kwargs):
         super().__init__(name, axis=axis, **kwargs)
@@ -169,9 +171,10 @@ class Accuracy(EvalMetric):
             self._update(float(correct), len(label))
 
 
+@register(name='top_k_acc')
 @register(name='top_k_accuracy')
 class TopKAccuracy(EvalMetric):
-    """Ref: metric.py:510."""
+    """Ref: metric.py:510 (+ 'top_k_acc' alias)."""
 
     def __init__(self, top_k=1, name='top_k_accuracy', **kwargs):
         super().__init__(name, top_k=top_k, **kwargs)

@@ -96,6 +96,8 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if monitor is not None:
+            self.install_monitor(monitor)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -106,8 +108,12 @@ class BaseModule:
             nbatch = 0
             train_data.reset()
             for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     bec = BatchEndParam(epoch, nbatch, eval_metric)
@@ -131,6 +137,13 @@ class BaseModule:
     @property
     def symbol(self):
         return self._symbol
+
+    def install_monitor(self, mon):
+        """Install a Monitor on every bound executor (ref:
+        base_module.py install_monitor)."""
+        assert self.binded, 'call bind before installing a monitor'
+        for e in self._execs:
+            mon.install(e)
 
     # abstract methods
     def bind(self, *args, **kwargs):

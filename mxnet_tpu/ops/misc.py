@@ -7,6 +7,8 @@ softmax_output.cc,slice_channel.cc}.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -260,7 +262,10 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization='null'):
     return MakeLoss(data, grad_scale, valid_thresh, normalization)
 
 
-@jax.custom_vjp
+# ignore_label/use_ignore/multi_output are static config, not primals:
+# as nondiff_argnums they stay python values under jit/vjp (a traced
+# bool here raised TracerBoolConversionError on the inference path)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _softmax_output_p(data, label, grad_scale, ignore_label, use_ignore,
                       multi_output):
     return _softmax_fwd(data, multi_output)
@@ -274,12 +279,11 @@ def _softmax_fwd(data, multi_output):
 def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
                         multi_output):
     out = _softmax_fwd(data, multi_output)
-    return out, (out, label, grad_scale, ignore_label, use_ignore,
-                 multi_output)
+    return out, (out, label, grad_scale)
 
 
-def _softmax_output_bwd(res, ct):
-    out, label, grad_scale, ignore_label, use_ignore, multi_output = res
+def _softmax_output_bwd(ignore_label, use_ignore, multi_output, res, ct):
+    out, label, grad_scale = res
     # gradient = (softmax - onehot(label)) * scale, head grad ignored
     # (ref: src/operator/softmax_output.cc SoftmaxOutputGrad)
     axis = 1 if multi_output and out.ndim > 2 else -1
@@ -296,7 +300,7 @@ def _softmax_output_bwd(res, ct):
         else:
             mask = mask[..., None]
         g = g * mask.astype(out.dtype)
-    return (g, None, None, None, None, None)
+    return (g, None, None)
 
 
 _softmax_output_p.defvjp(_softmax_output_fwd, _softmax_output_bwd)

@@ -92,19 +92,22 @@ def _dot_csr_dense_dispatch(lhs, rhs, transpose_a=False,
 
 def _dot_csr_prepare(args, kwargs):
     """nnz budget from the CONCRETE payload, cached on the wrapper so a
-    training loop reusing one CSR matrix counts once, not per step."""
+    training loop reusing one CSR matrix counts once, not per step. The
+    cache holds a WEAK reference to the payload — replacing ._data must
+    not pin the old device buffer alive."""
+    import weakref
     import numpy as onp
     lhs = args[0]
     data = getattr(lhs, '_data', None)
     cached = getattr(lhs, '_nnz_cache', None)
-    if cached is not None and data is not None and cached[0] is data:
+    if cached is not None and data is not None and cached[0]() is data:
         return {'nse': cached[1]}
     payload = lhs.asnumpy() if hasattr(lhs, 'asnumpy') else onp.asarray(lhs)
     nse = max(1, int(onp.count_nonzero(payload)))
     if data is not None:
         try:
-            lhs._nnz_cache = (data, nse)
-        except AttributeError:  # __slots__ without the cache slot
+            lhs._nnz_cache = (weakref.ref(data), nse)
+        except (AttributeError, TypeError):  # no slot / unweakrefable
             pass
     return {'nse': nse}
 

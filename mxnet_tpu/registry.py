@@ -1,0 +1,69 @@
+"""Generic object-registry helpers (ref: python/mxnet/registry.py):
+module-level sugar over base.Registry so user code can build registered,
+string-creatable class families exactly like optimizers/initializers."""
+from __future__ import annotations
+
+import json
+
+from .base import Registry, MXNetError
+
+_registries = {}
+
+
+def _get(base_class, nickname):
+    key = (base_class, nickname)
+    if key not in _registries:
+        _registries[key] = Registry(nickname)
+    return _registries[key]
+
+
+def get_register_func(base_class, nickname):
+    """A decorator registering subclasses of `base_class`
+    (ref: registry.py get_register_func)."""
+    reg = _get(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of {base_class.__name__}")
+        reg.register(klass, name=(name or klass.__name__).lower())
+        return klass
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """A decorator adding alias names (ref: registry.py get_alias_func)."""
+    reg = _get(base_class, nickname)
+
+    def alias(*aliases):
+        def deco(klass):
+            for a in aliases:
+                reg.register(klass, name=a.lower())
+            return klass
+        return deco
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A factory creating registered objects from a name or a
+    '{"name": ..., kwargs...}' json string (ref: registry.py
+    get_create_func)."""
+    reg = _get(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args:
+            raise MXNetError(f"{nickname} name required")
+        name, args = args[0], args[1:]
+        if isinstance(name, str) and name.startswith('{'):
+            cfg = json.loads(name)
+            name = cfg.pop('name')
+            kwargs.update(cfg)
+        try:
+            klass = reg.get(name.lower())
+        except Exception:
+            raise MXNetError(
+                f"{name!r} is not a registered {nickname}") from None
+        return klass(*args, **kwargs)
+    return create

@@ -55,7 +55,13 @@ class Symbol:
         self.op = op                  # None => variable
         self.inputs = list(inputs)
         self.attrs = dict(attrs or {})
-        if name is None:
+        from .name import current as _nm_current
+        nm = _nm_current()
+        if nm is not None:
+            # managers see explicit names too: Prefix prepends to both
+            # (reference semantics, name.py Prefix.get)
+            name = nm.get(name, op or 'var')
+        elif name is None:
             base = op if op else 'var'
             Symbol._counter[0] += 1
             name = f"{base}{Symbol._counter[0]}"
@@ -99,9 +105,14 @@ class Symbol:
                 return self
             if not 0 <= idx < self.num_outputs:
                 raise MXNetError("index out of range")
-            view = Symbol(self.op, self.inputs, self.attrs, self._name,
-                          self.num_outputs, idx)
-            view._uid = self._uid  # same node, different output slot
+            view = Symbol.__new__(Symbol)
+            view.op = self.op
+            view.inputs = list(self.inputs)
+            view.attrs = dict(self.attrs)
+            view._name = self._name   # verbatim: no NameManager re-prefix
+            view.num_outputs = self.num_outputs
+            view.out_index = idx
+            view._uid = self._uid     # same node, different output slot
             return view
         raise MXNetError("Symbol only supports integer indexing")
 
@@ -250,7 +261,7 @@ class _SymbolList(list):
         return super().__getitem__(key)
 
 
-def _eval_node(s, bindings, cache, device_map=None):
+def _eval_node(s, bindings, cache, device_map=None, hook=None):
     # cache by node uid: indexed output views of one multi-output node
     # share the uid, so the op runs once; distinct nodes never collide
     # even under duplicate user-assigned names
@@ -261,9 +272,11 @@ def _eval_node(s, bindings, cache, device_map=None):
         if s._name not in bindings:
             raise MXNetError(f"unbound variable {s._name}")
         out = bindings[s._name]
+        if hook is not None:
+            hook(s, out)
         cache[base_key] = out
     else:
-        in_vals = [_eval_node(i, bindings, cache, device_map)
+        in_vals = [_eval_node(i, bindings, cache, device_map, hook)
                    for i in s.inputs]
         opdef = get_op(s.op)
         clean_attrs = {k: v for k, v in s.attrs.items()
@@ -282,6 +295,8 @@ def _eval_node(s, bindings, cache, device_map=None):
                 in_vals = [_jax.device_put(v, target) if hasattr(v, 'devices')
                            else v for v in in_vals]
         out = opdef.fn(*in_vals, **clean_attrs)
+        if hook is not None:
+            hook(s, out)
         cache[base_key] = out
     if isinstance(out, tuple):
         return out[s.out_index]
@@ -404,6 +419,24 @@ class Executor:
 
         self._f = f
         self._jit_fwd = f if self._device_map else jax.jit(f)
+        self._monitor = None  # set by monitor.Monitor.install
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Reference API (executor.py set_monitor_callback): `callback`
+        receives (name, value) for every node output on every forward —
+        an always-active monitor without interval gating."""
+        class _AlwaysOn:
+            activated = True
+
+            def __init__(self, cb, mall):
+                self._cb = cb
+                self.monitor_all = mall
+
+            def _record(self, name, value):
+                self._cb(name, value)
+
+        self._monitor = None if callback is None else \
+            _AlwaysOn(callback, monitor_all)
 
     @property
     def aux_dict(self):
@@ -416,7 +449,27 @@ class Executor:
             else:
                 self.arg_dict[k]._data = jnp.asarray(v)
         bind = {n: self.arg_dict[n]._data for n in self._names}
-        if is_train and self._grad_req != 'null':
+        mon = getattr(self, '_monitor', None)
+        if mon is not None and mon.activated:
+            # monitored forward: eager per-node evaluation feeding the
+            # monitor's stat queue (ref: monitor.py — the engine callback
+            # path; bulking is likewise disabled there)
+            def _rec(node, value):
+                if node.op is None and not getattr(mon, 'monitor_all',
+                                                   False):
+                    return  # inputs/weights only under monitor_all
+                vals = value if isinstance(value, tuple) else (value,)
+                for vi, v in enumerate(vals):
+                    nm = node._name + (f'_out{vi}' if len(vals) > 1 else
+                                       '_output')
+                    mon._record(nm, v)
+            out = _eval_node(self._symbol, bind, {}, self._device_map,
+                             _rec)
+            if is_train and self._grad_req != 'null':
+                _, self._vjp = jax.vjp(self._f, bind)
+            else:
+                self._vjp = None
+        elif is_train and self._grad_req != 'null':
             out, self._vjp = jax.vjp(self._f, bind)
         else:
             out = self._jit_fwd(bind)

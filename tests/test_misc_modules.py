@@ -1,0 +1,242 @@
+"""The small reference python modules: name manager, monitor, log,
+libinfo, registry, executor_manager, kvstore_server (ref:
+python/mxnet/{name,monitor,log,libinfo,registry,executor_manager,
+kvstore_server}.py)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_name_manager_counters_and_prefix():
+    from mxnet_tpu.name import NameManager, Prefix
+    with NameManager():
+        a = sym.sin(sym.Variable('x'))
+        b = sym.sin(sym.Variable('y'))
+        c = sym.cos(a)
+    assert a.name == 'sin0' and b.name == 'sin1' and c.name == 'cos0'
+    with Prefix('net_'):
+        d = sym.sin(sym.Variable('z'))
+    assert d.name == 'net_sin0'
+    # outside any manager the global fallback still names uniquely
+    e, f = sym.sin(sym.Variable('u')), sym.sin(sym.Variable('v'))
+    assert e.name != f.name
+
+
+def test_monitor_collects_stats():
+    from mxnet_tpu.monitor import Monitor
+    x = sym.Variable('x')
+    y = sym.sin(x, name='s1')
+    z = sym.cos(y, name='c1')
+    exe = z.simple_bind(mx.cpu(0), grad_req='null', x=(2, 3))
+    import jax.numpy as jnp
+    exe.arg_dict['x']._data = jnp.asarray(
+        onp.random.RandomState(0).randn(2, 3).astype('float32'))
+
+    mon = Monitor(interval=2, pattern='.*')
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    rows = mon.toc()
+    names = {r[1] for r in rows}
+    assert 's1_output' in names and 'c1_output' in names
+    # interval gating: the next batch is unmonitored
+    mon.tic()
+    exe.forward()
+    assert mon.toc() == []
+    # monitored forward matches the compiled one
+    out_m = exe.forward()[0].asnumpy()
+    exe2 = z.simple_bind(mx.cpu(0), grad_req='null', x=(2, 3))
+    exe2.arg_dict['x']._data = exe.arg_dict['x']._data
+    onp.testing.assert_allclose(out_m, exe2.forward()[0].asnumpy(),
+                                rtol=1e-6)
+
+
+def test_monitor_pattern_filter():
+    from mxnet_tpu.monitor import Monitor
+    x = sym.Variable('x')
+    z = sym.cos(sym.sin(x, name='keepme'), name='dropme')
+    exe = z.simple_bind(mx.cpu(0), grad_req='null', x=(2, 2))
+    import jax.numpy as jnp
+    exe.arg_dict['x']._data = jnp.ones((2, 2), jnp.float32)
+    mon = Monitor(interval=1, pattern='keepme.*')
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    rows = mon.toc()
+    assert [r[1] for r in rows] == ['keepme_output']
+
+
+def test_log_get_logger():
+    from mxnet_tpu import log
+    lg = log.get_logger('mxtpu_test_logger', level=log.INFO)
+    assert lg.level == logging.INFO
+    assert log.get_logger('mxtpu_test_logger') is lg  # idempotent
+
+
+def test_libinfo_paths():
+    from mxnet_tpu import libinfo
+    libs = libinfo.find_lib_path()
+    assert all(p.endswith('.so') for p in libs)
+    import os
+    assert os.path.isdir(libinfo.find_include_path())
+
+
+def test_registry_module():
+    from mxnet_tpu import registry
+
+    class Base:
+        pass
+
+    register = registry.get_register_func(Base, 'thing')
+    alias = registry.get_alias_func(Base, 'thing')
+    create = registry.get_create_func(Base, 'thing')
+
+    @register
+    @alias('fx')
+    class FooThing(Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    assert isinstance(create('foothing'), FooThing)
+    assert isinstance(create('fx'), FooThing)
+    assert create('{"name": "foothing", "v": 7}').v == 7
+    with pytest.raises(mx.MXNetError):
+        create('nope')
+
+
+def test_executor_module_reexport():
+    from mxnet_tpu.executor import Executor
+    from mxnet_tpu.symbol import Executor as E2
+    assert Executor is E2
+
+
+def test_executor_manager_forward_backward():
+    from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                            _split_input_slice)
+    assert _split_input_slice(10, [1, 1]) == [slice(0, 5), slice(5, 10)]
+    x = sym.Variable('data')
+    w = sym.Variable('w', shape=(1, 4))
+    out = sym.FullyConnected(x, w, None, num_hidden=1, no_bias=True,
+                             name='fc')
+    mgr = DataParallelExecutorManager(
+        out, ctx=[mx.cpu(0), mx.cpu(0)],
+        data_shapes=[('data', (8, 4))], param_names=['w'])
+    assert len(mgr.execs) == 2
+    rng = onp.random.RandomState(0)
+    X = rng.randn(8, 4).astype('float32')
+    import collections
+    batch = collections.namedtuple('B', ['data', 'label'])(
+        [nd.array(X)], [])
+    for e in mgr.execs:
+        e.arg_dict['w']._data = nd.array(
+            onp.ones((1, 4), 'float32'))._data
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    got = onp.concatenate([e.outputs[0].asnumpy() for e in mgr.execs])
+    onp.testing.assert_allclose(got, X @ onp.ones((4, 1), 'float32'),
+                                rtol=1e-5)
+    mgr.backward()
+    assert mgr.grad_arrays[0][0].shape == (1, 4)
+
+
+def test_kvstore_server_role_noop():
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    KVStoreServer(None).run()  # returns immediately, no aggregation role
+
+
+def test_prefix_applies_to_explicit_names():
+    """Prefix prepends to explicit names too (reference Prefix.get), and
+    indexed views never re-prefix."""
+    from mxnet_tpu.name import Prefix
+    with Prefix('net1_'):
+        w = sym.Variable('w')
+    with Prefix('net2_'):
+        w2 = sym.Variable('w')
+    assert w.name == 'net1_w' and w2.name == 'net2_w'
+    assert w._uid != w2._uid  # no silent aliasing across prefixes
+    with Prefix('p_'):
+        parts = sym.split(sym.Variable('x'), num_outputs=2, name='sp')
+    assert parts[0].name == parts[1].name == 'p_sp'
+
+
+def test_monitor_all_records_inputs():
+    from mxnet_tpu.monitor import Monitor
+    x = sym.Variable('xin')
+    z = sym.sin(x, name='op1')
+    exe = z.simple_bind(mx.cpu(0), grad_req='null', xin=(2, 2))
+    import jax.numpy as jnp
+    exe.arg_dict['xin']._data = jnp.ones((2, 2), jnp.float32)
+    mon = Monitor(interval=1, monitor_all=True)
+    mon.install(exe)
+    mon.tic(); exe.forward()
+    names = {r[1] for r in mon.toc()}
+    assert 'xin_output' in names and 'op1_output' in names
+    # without monitor_all, inputs are excluded
+    mon2 = Monitor(interval=1)
+    mon2.install(exe)
+    mon2.tic(); exe.forward()
+    names2 = {r[1] for r in mon2.toc()}
+    assert 'xin_output' not in names2 and 'op1_output' in names2
+
+
+def test_set_monitor_callback():
+    collected = []
+    x = sym.Variable('x')
+    z = sym.sin(x, name='m1')
+    exe = z.simple_bind(mx.cpu(0), grad_req='null', x=(2, 2))
+    import jax.numpy as jnp
+    exe.arg_dict['x']._data = jnp.ones((2, 2), jnp.float32)
+    exe.set_monitor_callback(lambda name, v: collected.append(name))
+    exe.forward()
+    assert 'm1_output' in collected
+    exe.set_monitor_callback(None)
+    collected.clear()
+    exe.forward()
+    assert collected == []
+
+
+def test_module_fit_with_monitor(caplog):
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.monitor import Monitor
+    rng = onp.random.RandomState(0)
+    X = rng.randn(32, 6).astype('float32')
+    Y = (X.sum(1) > 0).astype('float32')
+    x = sym.Variable('data')
+    w = sym.Variable('fc_weight', shape=(2, 6))
+    b = sym.Variable('fc_bias', shape=(2,))
+    out = sym.SoftmaxOutput(
+        sym.FullyConnected(x, w, b, num_hidden=2, name='fc'),
+        sym.Variable('softmax_label'), name='softmax')
+    mod = Module(out, data_names=('data',),
+                 label_names=('softmax_label',), context=mx.cpu(0))
+    it = NDArrayIter(X, Y, batch_size=16, label_name='softmax_label')
+    mon = Monitor(interval=1)
+    with caplog.at_level(logging.INFO):
+        mod.fit(it, num_epoch=1, monitor=mon,
+                optimizer_params=(('learning_rate', 0.1),))
+    assert any('fc_output' in r.message or 'softmax' in r.message
+               for r in caplog.records), \
+        [r.message for r in caplog.records][:5]
+
+
+def test_softmax_output_jit_inference():
+    """softmax_output compiles under jit with its static config args
+    (regression: bool config became a tracer on the compiled inference
+    path and raised TracerBoolConversionError)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.base import get_op
+    f = get_op('softmax_output').fn
+    d = jnp.asarray(onp.random.RandomState(0).randn(4, 3), jnp.float32)
+    lab = jnp.asarray([0, 1, 2, 1], jnp.int32)
+    out = jax.jit(lambda d, l: f(d, l, use_ignore=True,
+                                 ignore_label=-1))(d, lab)
+    onp.testing.assert_allclose(
+        onp.asarray(out), onp.asarray(jax.nn.softmax(d, -1)), rtol=1e-6)
+    g = jax.grad(lambda d: jnp.sum(f(d, lab)))(d)
+    assert onp.isfinite(onp.asarray(g)).all()
