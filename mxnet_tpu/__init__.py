@@ -64,6 +64,9 @@ from . import registry
 from . import executor
 from . import executor_manager
 from . import kvstore_server
+# reference-launcher compat: a DMLC_ROLE=server process exits here with
+# the (empty) server role instead of running the training script body
+kvstore_server._init_kvstore_server_module()
 from . import image
 
 __all__ = ['nd', 'ndarray', 'autograd', 'gluon', 'optimizer', 'metric', 'io',

@@ -45,20 +45,26 @@ class DataParallelExecutorManager:
         assert len(work_load_list) == len(self.ctx)
         self._work_load_list = work_load_list
 
+        # I/O names keep the PROVIDE order (data first, then labels):
+        # load_data_batch zips batch tensors against this order, so it
+        # must match the iterator's, not alphabetical order
         shapes = {}
-        for desc_list in (data_shapes or [], label_shapes or []):
+        self._io_names = []
+
+        def add(desc_list):
             for desc in desc_list:
                 name, shape = (desc.name, desc.shape) \
                     if hasattr(desc, 'name') else desc[:2]
+                if name not in shapes:
+                    self._io_names.append(name)
                 shapes[name] = tuple(shape)
+
+        add(data_shapes or [])
+        add(label_shapes or [])
         if train_data is not None:
-            for desc in getattr(train_data, 'provide_data', []) + \
-                    getattr(train_data, 'provide_label', []):
-                name, shape = (desc.name, desc.shape) \
-                    if hasattr(desc, 'name') else desc[:2]
-                shapes[name] = tuple(shape)
-        self._io_names = sorted(shapes)
-        batch = shapes[self._io_names[0]][0] if shapes else 0
+            add(list(getattr(train_data, 'provide_data', [])))
+            add(list(getattr(train_data, 'provide_label', [])))
+        batch = shapes[self._io_names[0]][0] if self._io_names else 0
         self.slices = _split_input_slice(batch, work_load_list)
 
         arg_names = arg_names or symbol.list_arguments()
@@ -97,8 +103,19 @@ class DataParallelExecutorManager:
                                allow_extra_params=True)
 
     def copy_to(self, arg_params, aux_params=None):
+        """Copy current parameter VALUES out (ref: executor_manager.py
+        copy_to — a snapshot, not an alias of the live weights)."""
         for name in self.param_names:
-            arg_params[name] = self.execs[0].arg_dict[name]
+            src = self.execs[0].arg_dict[name]
+            if name in arg_params:
+                arg_params[name]._data = src._data
+            else:
+                arg_params[name] = array(src.asnumpy())
+        if aux_params is not None:
+            for name in self.aux_names:
+                if name in self.execs[0].aux_dict:
+                    aux_params[name] = array(
+                        self.execs[0].aux_dict[name].asnumpy())
 
     def load_data_batch(self, data_batch):
         datas = list(data_batch.data) + list(data_batch.label or [])

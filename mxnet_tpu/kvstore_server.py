@@ -15,9 +15,10 @@ import os
 
 
 class KVStoreServer:
-    """Role-compat server loop (ref: kvstore_server.py:KVStoreServer).
-    run() blocks until the job's workers finish (jax.distributed
-    shutdown), performing no aggregation of its own."""
+    """Role-compat server shim (ref: kvstore_server.py:KVStoreServer).
+    run() logs the design note and returns immediately: there is no
+    aggregation work in this backend, so a server-role process has
+    nothing to do and should exit cleanly (workers never depend on it)."""
 
     def __init__(self, kvstore):
         self.kvstore = kvstore
@@ -25,19 +26,21 @@ class KVStoreServer:
     def run(self):
         logging.info(
             "mxnet_tpu kvstore server role: aggregation happens inside "
-            "the compiled step (XLA all-reduce); server idles until "
-            "shutdown")
-        # nothing to serve: return immediately so the process exits
-        # cleanly — workers do not depend on it
+            "the compiled step (XLA all-reduce over ICI/DCN); this "
+            "backend has no server work — exiting the server role")
         return
 
 
 def _init_kvstore_server_module():
-    """Ref: kvstore_server.py:_init_kvstore_server_module — spawns the
-    server loop when DMLC_ROLE=server."""
+    """Invoked at package import (mxnet_tpu/__init__.py, mirroring the
+    reference's import-time hook): a DMLC_ROLE=server process runs the
+    (empty) server role and EXITS before any user training code — the
+    reference's server processes likewise never execute the script body.
+    Returns True in the server role (after which the interpreter exits);
+    False otherwise."""
     if os.environ.get('DMLC_ROLE') == 'server':
-        from . import kvstore as kv
-        server = KVStoreServer(kv.create('dist_sync'))
+        server = KVStoreServer(None)
         server.run()
-        return True
+        import sys
+        sys.exit(0)
     return False

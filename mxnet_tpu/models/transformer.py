@@ -31,19 +31,24 @@ class PositionalEncoding(HybridBlock):
 
 
 class MultiHeadAttention(HybridBlock):
-    def __init__(self, hidden, heads, dropout=0.1, **kwargs):
+    """`causal` is a construction-time flag: Block.__call__ forwards only
+    positional tensors (reference semantics), so masking mode cannot ride
+    the call."""
+
+    def __init__(self, hidden, heads, dropout=0.1, causal=False, **kwargs):
         super().__init__(**kwargs)
         self._heads = heads
+        self._causal = causal
         with self.name_scope():
             self.q_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
             self.k_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
             self.v_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
             self.out_proj = nn.Dense(hidden, flatten=False, in_units=hidden)
 
-    def forward(self, q, k, v, mask=None, causal=False):
+    def forward(self, q, k, v, mask=None):
         out = _invoke(attn_ops.multi_head_attention,
                       self.q_proj(q), self.k_proj(k), self.v_proj(v), mask,
-                      num_heads=self._heads, causal=causal)
+                      num_heads=self._heads, causal=self._causal)
         return self.out_proj(out)
 
 
@@ -68,7 +73,8 @@ class DecoderLayer(HybridBlock):
     def __init__(self, hidden, heads, ffn_hidden, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.self_attn = MultiHeadAttention(hidden, heads, dropout)
+            self.self_attn = MultiHeadAttention(hidden, heads, dropout,
+                                                causal=True)
             self.ln1 = nn.LayerNorm(in_channels=hidden)
             self.cross_attn = MultiHeadAttention(hidden, heads, dropout)
             self.ln2 = nn.LayerNorm(in_channels=hidden)
@@ -78,7 +84,7 @@ class DecoderLayer(HybridBlock):
             self.drop = nn.Dropout(dropout)
 
     def forward(self, x, memory, mem_mask=None):
-        x = self.ln1(x + self.drop(self.self_attn(x, x, x, causal=True)))
+        x = self.ln1(x + self.drop(self.self_attn(x, x, x)))
         x = self.ln2(x + self.drop(self.cross_attn(x, memory, memory,
                                                    mem_mask)))
         h = self.ffn2(nd.activation(self.ffn1(x), act_type='relu'))

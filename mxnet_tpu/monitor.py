@@ -35,12 +35,10 @@ class Monitor:
         self.step = 0
         self.activated = False
         self.queue = []
-        self._exes = []
 
     def install(self, exe):
         """Attach to an Executor (ref: executor.set_monitor_callback)."""
         exe._monitor = self
-        self._exes.append(exe)
         return exe
 
     def tic(self):

@@ -57,8 +57,12 @@ def get_create_func(base_class, nickname):
             raise MXNetError(f"{nickname} name required")
         name, args = args[0], args[1:]
         if isinstance(name, str) and name.startswith('{'):
-            cfg = json.loads(name)
-            name = cfg.pop('name')
+            try:
+                cfg = json.loads(name)
+                name = cfg.pop('name')
+            except (json.JSONDecodeError, KeyError) as e:
+                raise MXNetError(
+                    f"invalid {nickname} config string: {e!r}") from None
             kwargs.update(cfg)
         try:
             klass = reg.get(name.lower())

@@ -148,6 +148,35 @@ def test_kvstore_server_role_noop():
     KVStoreServer(None).run()  # returns immediately, no aggregation role
 
 
+def test_server_role_process_exits_at_import():
+    """A DMLC_ROLE=server process exits cleanly at import without running
+    the script body (reference launch-compat)."""
+    import os
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, '-c',
+         'import mxnet_tpu; print("SHOULD_NOT_RUN")'],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, 'DMLC_ROLE': 'server',
+             'JAX_PLATFORMS': 'cpu'})
+    assert r.returncode == 0
+    assert 'SHOULD_NOT_RUN' not in r.stdout
+
+
+def test_registry_invalid_config_raises_mxnet_error():
+    from mxnet_tpu import registry
+
+    class B2:
+        pass
+
+    create = registry.get_create_func(B2, 'widget')
+    with pytest.raises(mx.MXNetError, match='invalid widget config'):
+        create('{"v": 7}')     # missing name key
+    with pytest.raises(mx.MXNetError):
+        create('{not json')
+
+
 def test_prefix_applies_to_explicit_names():
     """Prefix prepends to explicit names too (reference Prefix.get), and
     indexed views never re-prefix."""

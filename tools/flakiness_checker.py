@@ -1,0 +1,54 @@
+"""Flakiness checker (ref: tools/flakiness_checker.py): run one test
+many times with distinct seeds and report failures.
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_dot -n 50
+    python tools/flakiness_checker.py test_operator.test_dot   # ref syntax
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def normalize_selector(sel):
+    """Accept pytest selectors and the reference's module.test syntax."""
+    if '::' in sel or sel.endswith('.py') or os.path.exists(sel.split('::')[0]):
+        return sel
+    if '.' in sel:
+        mod, test = sel.rsplit('.', 1)
+        path = os.path.join('tests', mod + '.py')
+        return f"{path}::{test}"
+    return sel
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('test', help='pytest selector or module.test_name')
+    ap.add_argument('-n', '--num-trials', type=int, default=30)
+    ap.add_argument('-s', '--seed', type=int, default=None,
+                    help='fixed seed (default: trial index)')
+    ap.add_argument('-v', '--verbose', action='store_true')
+    args = ap.parse_args()
+
+    sel = normalize_selector(args.test)
+    failures = 0
+    for trial in range(args.num_trials):
+        env = dict(os.environ,
+                   MXNET_TEST_SEED=str(args.seed if args.seed is not None
+                                       else trial),
+                   JAX_PLATFORMS=os.environ.get('JAX_PLATFORMS', 'cpu'))
+        res = subprocess.run(
+            [sys.executable, '-m', 'pytest', sel, '-q', '-x'],
+            capture_output=True, text=True, env=env)
+        ok = res.returncode == 0
+        failures += (not ok)
+        if args.verbose or not ok:
+            tail = res.stdout.strip().splitlines()[-1:] or ['?']
+            print(f"trial {trial}: {'PASS' if ok else 'FAIL'} {tail[0]}")
+    print(f"{args.num_trials - failures}/{args.num_trials} passed")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == '__main__':
+    main()

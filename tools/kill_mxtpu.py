@@ -1,0 +1,41 @@
+"""Kill stray framework processes on this machine (ref:
+tools/kill-mxnet.py, which pkills dangling ps-lite/worker processes
+after a crashed distributed job).
+
+Targets python processes whose command line references this repo's
+training entry points or launcher, excluding the calling process tree.
+
+Usage: python tools/kill_mxtpu.py [pattern]
+"""
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else 'mxnet_tpu'
+    me = os.getpid()
+    out = subprocess.run(['ps', '-eo', 'pid,ppid,args'],
+                         capture_output=True, text=True).stdout
+    victims = []
+    for line in out.strip().splitlines()[1:]:
+        parts = line.strip().split(None, 2)
+        if len(parts) < 3:
+            continue
+        pid, ppid, cmd = int(parts[0]), int(parts[1]), parts[2]
+        if pid in (me, os.getppid()):
+            continue
+        if 'python' in cmd and pattern in cmd:
+            victims.append((pid, cmd))
+    for pid, cmd in victims:
+        print(f"killing {pid}: {cmd[:100]}")
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    print(f"{len(victims)} process(es) signalled")
+
+
+if __name__ == '__main__':
+    main()
