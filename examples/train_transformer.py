@@ -58,13 +58,15 @@ def main():
     step = ShardedTrainStep(net, loss_fn, 'adam',
                             {'learning_rate': 3e-4}, mesh=mesh)
 
+    assert args.steps > 0, "--steps must be positive"
     rng = onp.random.RandomState(0)
     first = None
     for i in range(args.steps):
         src, tgt_in, tgt_out = make_batch(rng, args.batch_size, args.seq,
                                           args.vocab)
         loss = float(step([src, tgt_in], [tgt_out]).asnumpy())
-        first = first or loss
+        if first is None:
+            first = loss
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {loss:.4f}")
     print(f"loss {first:.4f} -> {loss:.4f}")

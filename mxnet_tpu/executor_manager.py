@@ -59,10 +59,13 @@ class DataParallelExecutorManager:
                     self._io_names.append(name)
                 shapes[name] = tuple(shape)
 
+        # all DATA names first (explicit + iterator), then all LABELS —
+        # the zip target must be [batch.data..., batch.label...]
         add(data_shapes or [])
-        add(label_shapes or [])
         if train_data is not None:
             add(list(getattr(train_data, 'provide_data', [])))
+        add(label_shapes or [])
+        if train_data is not None:
             add(list(getattr(train_data, 'provide_label', [])))
         batch = shapes[self._io_names[0]][0] if self._io_names else 0
         self.slices = _split_input_slice(batch, work_load_list)

@@ -18,13 +18,24 @@ def main():
     me = os.getpid()
     out = subprocess.run(['ps', '-eo', 'pid,ppid,args'],
                          capture_output=True, text=True).stdout
-    victims = []
+    parent_of = {}
+    rows = []
     for line in out.strip().splitlines()[1:]:
         parts = line.strip().split(None, 2)
         if len(parts) < 3:
             continue
         pid, ppid, cmd = int(parts[0]), int(parts[1]), parts[2]
-        if pid in (me, os.getppid()):
+        parent_of[pid] = ppid
+        rows.append((pid, cmd))
+    # the whole calling ancestry is off-limits, not just the direct parent
+    ancestors = set()
+    cur = me
+    while cur in parent_of and cur not in ancestors:
+        ancestors.add(cur)
+        cur = parent_of[cur]
+    victims = []
+    for pid, cmd in rows:
+        if pid in ancestors:
             continue
         if 'python' in cmd and pattern in cmd:
             victims.append((pid, cmd))
