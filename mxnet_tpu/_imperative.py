@@ -14,7 +14,7 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
-from .base import state, MXNetError, prof_flags
+from .base import state, MXNetError, prof_flags, record_op_use
 
 
 class TapeNode:
@@ -77,10 +77,15 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
 
     try:
         if prof_flags['op']:
-            return _invoke_profiled(fn, g, datas, tensor_inputs, recording)
+            out = _invoke_profiled(fn, g, datas, tensor_inputs, recording)
+            record_op_use(fn)   # after dispatch: a raising op is not covered
+            return out
         if not recording:
-            return g(*datas), tensor_inputs, None, g
+            out = g(*datas)
+            record_op_use(fn)
+            return out, tensor_inputs, None, g
         out_data, vjp_fn = jax.vjp(g, *datas)
+        record_op_use(fn)
         return out_data, tensor_inputs, vjp_fn, g
     except MXNetError:
         raise

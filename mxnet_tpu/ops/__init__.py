@@ -24,6 +24,8 @@ from . import quantization  # noqa: F401
 from . import numpy_ops   # noqa: F401
 from . import sparse_ops  # noqa: F401
 from . import graph      # noqa: F401
+from . import ref_compat  # noqa: F401
+from . import ref_aliases  # noqa: F401  (must come after all op modules)
 
 from .elemwise import *     # noqa: F401,F403
 from .reduce import *       # noqa: F401,F403

@@ -1,0 +1,1046 @@
+"""Reference-parity ops that close the long tail of the audit.
+
+Round 5's op-name parity audit (tools/extract_ref_ops.py →
+tests/fixtures/reference_op_names.txt) surfaced reference-registered ops
+with no equivalent here.  This module implements them TPU-natively: each
+is a pure jnp/lax function (XLA fuses and tiles), with jax.custom_vjp
+where the reference defines a non-autodiff gradient (regression outputs,
+KL sparse-reg identity).  Host/numpy is used only for calibration- and
+sampling-utility ops the reference also runs on CPU.
+
+Reference anchors are cited per op; no reference code is copied — the
+semantics come from the op documentation and well-known formulas.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import register_op
+from .. import random as _random
+
+__all__ = []
+
+
+def _reg(fn=None, *, name=None, nograd=False, num_outputs=1,
+         mutate_inputs=()):
+    def deco(f):
+        register_op(name or f.__name__, nograd=nograd,
+                    num_outputs=num_outputs, mutate_inputs=mutate_inputs)(f)
+        __all__.append(f.__name__)
+        return f
+    return deco(fn) if fn is not None else deco
+
+
+# ---------------------------------------------------------------------------
+# Small tensor ops (ref: src/operator/tensor/)
+# ---------------------------------------------------------------------------
+
+@_reg
+def stop_gradient(data):
+    """Identity forward, zero gradient (ref: tensor/elemwise_unary_op_basic.cc
+    BlockGrad; aliased as `BlockGrad` / `stop_gradient`)."""
+    return jax.lax.stop_gradient(data)
+
+
+@_reg(name='round')
+def round_op(data):
+    """Round half away from zero, matching the reference's ::round
+    (ref: tensor/elemwise_unary_op_basic.cc round) — NOT numpy's
+    round-half-to-even (that one is `_npi_around`)."""
+    return jnp.sign(data) * jnp.floor(jnp.abs(data) + 0.5)
+
+
+@_reg
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape, optionally only over an axis range
+    (ref: tensor/elemwise_unary_op_basic.cc reshape_like)."""
+    lshape, rshape = list(lhs.shape), list(rhs.shape)
+    if lhs_begin is None and lhs_end is None and rhs_begin is None \
+            and rhs_end is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = 0 if lhs_begin is None else lhs_begin % (len(lshape) + 1)
+    le = len(lshape) if lhs_end is None else lhs_end % (len(lshape) + 1)
+    rb = 0 if rhs_begin is None else rhs_begin % (len(rshape) + 1)
+    re_ = len(rshape) if rhs_end is None else rhs_end % (len(rshape) + 1)
+    new_shape = lshape[:lb] + rshape[rb:re_] + lshape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+@_reg
+def argmax_channel(data):
+    """Argmax over axis 1, float output (ref: tensor/broadcast_reduce_op_index.cc
+    argmax_channel)."""
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+@_reg
+def square_sum(data, axis=None, keepdims=False):
+    """sum(data**2) along axis — the reference's fused `_square_sum`
+    for row_sparse gradients (ref: tensor/square_sum.cc)."""
+    return jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims)
+
+
+@_reg
+def identity_with_attr_like_rhs(lhs, rhs):
+    """Identity of lhs carrying rhs's storage attrs (ref:
+    tensor/elemwise_unary_op_basic.cc _identity_with_attr_like_rhs).
+    Storage is uniform dense here, so it reduces to identity."""
+    return lhs
+
+
+@_reg
+def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    """Split at explicit indices or into equal sections
+    (ref: tensor/matrix_op.cc _split_v2)."""
+    if sections:
+        pieces = jnp.split(data, sections, axis=axis)
+    else:
+        pieces = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        pieces = [jnp.squeeze(p, axis=axis) for p in pieces]
+    return tuple(pieces)
+
+
+def _normalize_begin_end(shape, begin, end, step=None):
+    import builtins
+    ndim = len(shape)
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step or []) + [None] * (ndim - len(step or []))
+    return tuple(builtins.slice(b, e, s)
+                 for b, e, s in zip(begin, end, step))
+
+
+@_reg
+def slice_assign(lhs, rhs, begin=(), end=(), step=None):
+    """Return lhs with lhs[begin:end:step] = rhs (ref: tensor/matrix_op.cc
+    _slice_assign; functional — the mutable-handle NDArray layer maps
+    in-place `x[a:b] = y` onto this)."""
+    idx = _normalize_begin_end(lhs.shape, begin, end, step)
+    return lhs.at[idx].set(rhs)
+
+
+@_reg
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
+    """Ref: tensor/matrix_op.cc _slice_assign_scalar."""
+    idx = _normalize_begin_end(data.shape, begin, end, step)
+    return data.at[idx].set(jnp.asarray(scalar, data.dtype))
+
+
+@_reg
+def scatter_set_nd(lhs, rhs, indices, shape=None):
+    """lhs with lhs[indices] = rhs — the set-variant of scatter_nd
+    (ref: tensor/indexing_op.cc _scatter_set_nd)."""
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+# `_scatter_plus_scalar` etc. exist in the reference so that sparse
+# gradient flows keep storage type; payloads are dense here, so the
+# scatter_* arithmetic collapses to the dense op (documented design:
+# ndarray/sparse.py).
+@_reg
+def scatter_plus_scalar(data, scalar=0.0):
+    """Ref: tensor/elemwise_binary_scalar_op_basic.cc _scatter_plus_scalar."""
+    return data + jnp.asarray(scalar, data.dtype)
+
+
+@_reg
+def scatter_minus_scalar(data, scalar=0.0):
+    """Ref: _scatter_minus_scalar."""
+    return data - jnp.asarray(scalar, data.dtype)
+
+
+@_reg
+def scatter_elemwise_div(lhs, rhs):
+    """Ref: tensor/elemwise_binary_op_basic.cc _scatter_elemwise_div."""
+    return lhs / rhs
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (ref: src/operator/nn/im2col.cc)
+# ---------------------------------------------------------------------------
+
+def _tuple2(v):
+    if v is None:
+        return (1, 1)
+    if isinstance(v, int):
+        return (v, v)
+    t = tuple(int(x) for x in v)
+    return t * 2 if len(t) == 1 else t
+
+
+@_reg
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Rearrange NCHW image blocks into columns: (N, C*kh*kw, L)
+    (ref: nn/im2col.cc im2col). Lowered with
+    conv_general_dilated_patches so XLA tiles it like a conv."""
+    kh, kw = _tuple2(kernel)
+    sh, sw = _tuple2(stride)
+    dh, dw = _tuple2(dilate)
+    ph, pw = _tuple2(pad)
+    patches = jax.lax.conv_general_dilated_patches(
+        data, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    n = data.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+@_reg
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Inverse of im2col: scatter-add columns back into (N, C, H, W)
+    (ref: nn/im2col.cc col2im)."""
+    kh, kw = _tuple2(kernel)
+    sh, sw = _tuple2(stride)
+    dh, dw = _tuple2(dilate)
+    ph, pw = _tuple2(pad)
+    oh, ow = _tuple2(output_size)
+    n = data.shape[0]
+    c = data.shape[1] // (kh * kw)
+    l_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    l_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = data.reshape(n, c, kh, kw, l_h, l_w)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), data.dtype)
+    # scatter-add each kernel tap's strided window; kh*kw is a static,
+    # small trip count so the unrolled loop stays XLA-friendly
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + l_h * sh:sh,
+                         j * dw:j * dw + l_w * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+# ---------------------------------------------------------------------------
+# linalg long tail (ref: src/operator/tensor/la_op.cc)
+# ---------------------------------------------------------------------------
+
+@_reg
+def linalg_gelqf(a):
+    """LQ factorization A = L·Q with Q orthonormal rows, for m <= n
+    (ref: la_op.cc _linalg_gelqf). Lowered via QR of Aᵀ."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode='reduced')
+    # normalize so L has a non-negative diagonal (LAPACK convention is
+    # sign-free; fixing the sign makes results deterministic/testable)
+    d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d).astype(a.dtype)
+    l_mat = jnp.swapaxes(r * d[..., None, :], -1, -2)
+    q_mat = jnp.swapaxes(q * d[..., None, :], -1, -2)
+    return l_mat, q_mat
+
+
+@_reg
+def linalg_syevd(a):
+    """Symmetric eigendecomposition A = Uᵀ·diag(L)·U with eigenvectors in
+    the ROWS of U, matching the reference's layout
+    (ref: la_op.cc _linalg_syevd)."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@_reg
+def linalg_extracttrian(a, offset=0, lower=True):
+    """Extract a triangle of each batched square matrix into a packed
+    vector (ref: la_op.cc _linalg_extracttrian)."""
+    n = a.shape[-1]
+    rows, cols = onp.tril_indices(n, k=offset) if lower \
+        else onp.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+@_reg
+def linalg_maketrian(a, offset=0, lower=True):
+    """Inverse of extracttrian: unpack a vector into a triangular matrix
+    (ref: la_op.cc _linalg_maketrian)."""
+    k = a.shape[-1]
+    # recover n from the packed length (static shape → host-side search)
+    n = 1
+    while True:
+        rows, cols = onp.tril_indices(n, k=offset) if lower \
+            else onp.triu_indices(n, k=offset)
+        if len(rows) == k:
+            break
+        if len(rows) > k or n > 16384:
+            raise ValueError(
+                f"maketrian: packed length {k} does not correspond to a "
+                f"triangle with offset {offset}")
+        n += 1
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+# ---------------------------------------------------------------------------
+# Regression outputs (ref: src/operator/regression_output.cc:29-80)
+# ---------------------------------------------------------------------------
+
+# The reference's XXXRegressionOutput ops ignore the incoming head
+# gradient and write (link(pred) − label)·grad_scale into the backward —
+# they are loss layers, not differentiable links. custom_vjp reproduces
+# exactly that (ref: regression_output.cc:29-80).
+
+def _regression(link, grad_fn):
+    def op(data, label, grad_scale=1.0):
+        @jax.custom_vjp
+        def core(pred, lab):
+            return link(pred)
+
+        def fwd(pred, lab):
+            return link(pred), (link(pred), lab)
+
+        def bwd(res, g):
+            out, lab = res
+            gs = jnp.asarray(grad_scale, out.dtype)
+            return grad_fn(out, lab.reshape(out.shape)) * gs, \
+                jnp.zeros_like(lab)
+
+        core.defvjp(fwd, bwd)
+        return core(data, label.astype(data.dtype))
+    return op
+
+
+_linear_core = _regression(lambda x: x, lambda out, lab: out - lab)
+_mae_core = _regression(lambda x: x, lambda out, lab: jnp.sign(out - lab))
+_logistic_core = _regression(jax.nn.sigmoid, lambda out, lab: out - lab)
+
+
+@_reg
+def linear_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward = (pred - label)·grad_scale
+    (ref: regression_output.cc LinearRegressionOutput)."""
+    return _linear_core(data, label, grad_scale)
+
+
+@_reg
+def mae_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward = sign(pred - label)·grad_scale
+    (ref: regression_output.cc MAERegressionOutput)."""
+    return _mae_core(data, label, grad_scale)
+
+
+@_reg
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """Sigmoid forward; backward = (sigmoid(x) - label)·grad_scale
+    (ref: regression_output.cc LogisticRegressionOutput)."""
+    return _logistic_core(data, label, grad_scale)
+
+
+@_reg
+def softmax_activation(data, mode='instance'):
+    """Softmax over channels (mode='channel', axis 1) or over all
+    non-batch dims (mode='instance') (ref: nn/softmax_activation.cc)."""
+    if mode == 'channel':
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+@_reg
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Identity forward; backward adds the KL-sparsity penalty gradient
+    β·(−ρ/ρ̂ + (1−ρ)/(1−ρ̂)) on the batch-mean activation
+    (ref: identity_attach_KL_sparse_reg.cc). The reference keeps ρ̂ as a
+    momentum-smoothed aux state; functionally we use the current batch's
+    mean (momentum is accepted for signature parity)."""
+    @jax.custom_vjp
+    def core(x):
+        return x
+
+    def fwd(x):
+        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        return x, (jnp.zeros_like(x), rho_hat)
+
+    def bwd(res, g):
+        zero, rho_hat = res
+        rho = jnp.asarray(sparseness_target, rho_hat.dtype)
+        kl_grad = jnp.asarray(penalty, rho_hat.dtype) * (
+            -rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        n = zero.shape[0]
+        return (g + (zero + kl_grad) / n,)
+
+    core.defvjp(fwd, bwd)
+    return core(data)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling (ref: src/operator/roi_pooling.cc) and rotated ROI align
+# (ref: src/operator/contrib/rroi_align.cc)
+# ---------------------------------------------------------------------------
+
+@_reg
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed (ph, pw) grid
+    (ref: roi_pooling.cc ROIPooling). rois: (R, 5) [batch, x1, y1, x2, y2]
+    in image coords. Bin membership is computed as dense masks over the
+    feature map — static shapes, no gathers, so XLA vectorises it."""
+    ph, pw = _tuple2(pooled_size)
+    n, c, h, w = data.shape
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.floor(rois[:, 1] * spatial_scale + 0.5)
+    y1 = jnp.floor(rois[:, 2] * spatial_scale + 0.5)
+    x2 = jnp.floor(rois[:, 3] * spatial_scale + 0.5)
+    y2 = jnp.floor(rois[:, 4] * spatial_scale + 0.5)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = roi_h / ph            # (R,)
+    bin_w = roi_w / pw
+
+    ys = jnp.arange(h, dtype=data.dtype)          # feature-map coords
+    xs = jnp.arange(w, dtype=data.dtype)
+    py = jnp.arange(ph, dtype=data.dtype)
+    px = jnp.arange(pw, dtype=data.dtype)
+
+    # (R, ph, h): is feature row y inside bin py of roi r?
+    hstart = jnp.floor(py[None, :] * bin_h[:, None]) + y1[:, None]
+    hend = jnp.ceil((py[None, :] + 1) * bin_h[:, None]) + y1[:, None]
+    ymask = (ys[None, None, :] >= hstart[..., None]) & \
+            (ys[None, None, :] < hend[..., None])
+    wstart = jnp.floor(px[None, :] * bin_w[:, None]) + x1[:, None]
+    wend = jnp.ceil((px[None, :] + 1) * bin_w[:, None]) + x1[:, None]
+    xmask = (xs[None, None, :] >= wstart[..., None]) & \
+            (xs[None, None, :] < wend[..., None])
+
+    feat = data[batch_idx]                         # (R, C, H, W)
+    neg = jnp.asarray(-onp.inf, data.dtype)
+    # (R, 1, ph, 1, H, 1) & (R, 1, 1, pw, 1, W) → mask (R,1,ph,pw,H,W)
+    mask = ymask[:, None, :, None, :, None] & xmask[:, None, None, :, None, :]
+    vals = jnp.where(mask, feat[:, :, None, None, :, :], neg)
+    out = jnp.max(vals, axis=(-2, -1))
+    # empty bins produce -inf in the reference too (then 0 via is_empty);
+    # match the is_empty→0 behavior
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+@_reg
+def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sampling_ratio=2):
+    """Rotated ROI align (ref: contrib/rroi_align.cc _contrib_RROIAlign).
+    rois: (R, 6) [batch, cx, cy, w, h, angle_deg]; bilinear sampling on a
+    rotated grid, averaged per bin."""
+    ph, pw = _tuple2(pooled_size)
+    n, c, h, w = data.shape
+    s = max(int(sampling_ratio), 1)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    cx = rois[:, 1] * spatial_scale
+    cy = rois[:, 2] * spatial_scale
+    rw = jnp.maximum(rois[:, 3] * spatial_scale, 1.0)
+    rh = jnp.maximum(rois[:, 4] * spatial_scale, 1.0)
+    theta = rois[:, 5] * onp.pi / 180.0
+
+    # sample grid in roi-local coords: (ph*s, pw*s) points in [-.5, .5]
+    gy = (jnp.arange(ph * s) + 0.5) / (ph * s) - 0.5
+    gx = (jnp.arange(pw * s) + 0.5) / (pw * s) - 0.5
+    # build (R, ph*s, pw*s) absolute coords
+    yy = gy[None, :, None] * rh[:, None, None]
+    xx = gx[None, None, :] * rw[:, None, None]
+    cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+    sx = cx[:, None, None] + xx * cos_t[:, None, None] \
+        - yy * sin_t[:, None, None]
+    sy = cy[:, None, None] + xx * sin_t[:, None, None] \
+        + yy * cos_t[:, None, None]
+
+    x0 = jnp.floor(sx)
+    y0 = jnp.floor(sy)
+    fx = (sx - x0).astype(data.dtype)
+    fy = (sy - y0).astype(data.dtype)
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        feat = data[batch_idx]                     # (R, C, H, W)
+        r = jnp.arange(rois.shape[0])[:, None, None]
+        return feat[r, :, yi, xi]                  # (R, ph*s, pw*s, C)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    fx = fx[..., None]
+    fy = fy[..., None]
+    val = (v00 * (1 - fx) * (1 - fy) + v01 * fx * (1 - fy) +
+           v10 * (1 - fx) * fy + v11 * fx * fy)   # (R, ph*s, pw*s, C)
+    inb = ((sx >= -1) & (sx <= w) & (sy >= -1) & (sy <= h))[..., None]
+    val = jnp.where(inb, val, 0.0)
+    r_ = val.reshape(val.shape[0], ph, s, pw, s, -1)
+    out = jnp.mean(r_, axis=(2, 4))               # (R, ph, pw, C)
+    return jnp.moveaxis(out, -1, 1)
+
+
+# ---------------------------------------------------------------------------
+# contrib utilities
+# ---------------------------------------------------------------------------
+
+@_reg(nograd=True)
+def index_array(data, axes=None):
+    """Return the index grid of `data`: shape data.shape + (len(axes),)
+    (ref: contrib/index_array.cc _contrib_index_array)."""
+    nd = data.ndim
+    axes = tuple(range(nd)) if axes is None else tuple(axes)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in data.shape],
+                         indexing='ij')
+    return jnp.stack([grids[a % nd] for a in axes], axis=-1) \
+        .astype(jnp.int32)
+
+
+@_reg(nograd=True)
+def getnnz(data, axis=None):
+    """Count stored (non-zero) values (ref: contrib/nnz.cc _contrib_getnnz;
+    CSR-only there — dense payloads count actual non-zeros)."""
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int32)
+    return jnp.sum(nz, axis=axis).astype(jnp.int32)
+
+
+@_reg(nograd=True)
+def bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
+    """Greedy bipartite matching over a (..., N, M) score matrix, the
+    reference's anchor-assignment primitive
+    (ref: contrib/bounding_box.cc _contrib_bipartite_matching).
+    Returns (row_assignment (...,N), col_assignment (...,M)).
+    Sequential greedy selection is a lax.scan over min(N, topk) steps."""
+    scores = data
+    n, m = scores.shape[-2], scores.shape[-1]
+    steps = n if topk < 0 else min(topk, n)
+    big = jnp.asarray(onp.inf, scores.dtype)
+    sign = 1.0 if is_ascend else -1.0
+    work = scores * sign                                   # minimise
+    thresh = threshold * sign
+
+    def body(carry, _):
+        work, row_asg, col_asg = carry
+        flat = work.reshape(work.shape[:-2] + (n * m,))
+        idx = jnp.argmin(flat, axis=-1)
+        best = jnp.take_along_axis(flat, idx[..., None], axis=-1)[..., 0]
+        r, c = idx // m, idx % m
+        ok = best <= thresh
+        row_asg = jnp.where(
+            ok[..., None] & (jnp.arange(n) == r[..., None]),
+            c[..., None].astype(row_asg.dtype), row_asg)
+        col_asg = jnp.where(
+            ok[..., None] & (jnp.arange(m) == c[..., None]),
+            r[..., None].astype(col_asg.dtype), col_asg)
+        rowmask = (jnp.arange(n) == r[..., None])[..., None]
+        colmask = (jnp.arange(m) == c[..., None])[..., None, :]
+        work = jnp.where(ok[..., None, None] & (rowmask | colmask),
+                         big, work)
+        return (work, row_asg, col_asg), None
+
+    row0 = jnp.full(scores.shape[:-1], -1.0, scores.dtype)
+    col0 = jnp.full(scores.shape[:-2] + (m,), -1.0, scores.dtype)
+    (_, row_asg, col_asg), _ = jax.lax.scan(
+        body, (work, row0, col0), None, length=steps)
+    return row_asg, col_asg
+
+
+@_reg(nograd=True)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence threshold calibration for INT8 quantization
+    (ref: quantization/calibrate.cc _contrib_calibrate_entropy). Host-side
+    numpy (the reference also runs it once, offline, on CPU): sweep
+    thresholds, pick the one minimising KL(P‖Q) between the clipped
+    distribution and its quantized re-expansion.
+    Returns (threshold, divergence)."""
+    hist = onp.asarray(hist, dtype=onp.float64)
+    edges = onp.asarray(hist_edges, dtype=onp.float64)
+    num_bins = hist.size
+    assert num_bins + 1 == edges.size
+    zero_bin = onp.argmax(edges >= 0) - 1 if (edges < 0).any() else 0
+
+    def kl(p, q):
+        p = p / max(p.sum(), 1e-12)
+        q = q / max(q.sum(), 1e-12)
+        mask = p > 0
+        qq = onp.where(q > 0, q, 1e-12)
+        return float((p[mask] * onp.log(p[mask] / qq[mask])).sum())
+
+    best_t, best_d = float(edges[-1]), onp.inf
+    # candidate thresholds: bin upper edges from num_quantized_bins//2 out
+    start = max(num_quantized_bins // 2, 1)
+    for i in range(start, num_bins + 1):
+        # symmetric window of i bins around the zero point
+        lo = max(zero_bin - i, 0)
+        hi = min(zero_bin + i, num_bins)
+        p = hist[lo:hi].copy()
+        if p.sum() == 0:
+            continue
+        # outliers clip into the edge bins
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        # quantize the window into num_quantized_bins then re-expand
+        chunks = onp.array_split(p, num_quantized_bins)
+        q = onp.concatenate([
+            onp.full(len(ch), (ch.sum() / max((ch > 0).sum(), 1)))
+            * (ch > 0) for ch in chunks])
+        d = kl(p, q)
+        t = float(max(abs(edges[lo]), abs(edges[hi])))
+        if d < best_d:
+            best_d, best_t = d, t
+    return (jnp.asarray(best_t, jnp.float32),
+            jnp.asarray(best_d if onp.isfinite(best_d) else 0.0,
+                        jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Quantized op variants (ref: src/operator/quantization/)
+# ---------------------------------------------------------------------------
+
+def _dequant(x, mn, mx):
+    scale = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12) / 127.0
+    return x.astype(jnp.float32) * scale
+
+
+def _requant(x):
+    mx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    q = jnp.clip(jnp.round(x / mx * 127.0), -127, 127).astype(jnp.int8)
+    return q, -mx, mx
+
+
+@_reg(num_outputs=3)
+def quantized_act(data, min_data, max_data, act_type='relu'):
+    """INT8 activation; relu passes quantized values through with range
+    clipped at zero (ref: quantization/quantized_activation.cc)."""
+    if act_type != 'relu':
+        x = _dequant(data, min_data, max_data)
+        y = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
+             'softrelu': jax.nn.softplus}[act_type](x)
+        return _requant(y)
+    out = jnp.maximum(data, 0)
+    return out, jnp.maximum(min_data, 0.0), jnp.maximum(max_data, 0.0)
+
+
+@_reg(num_outputs=3)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3, **_ignored):
+    """INT8 inference batch norm: dequantize → affine normalise →
+    requantize (ref: quantization/quantized_batch_norm.cc)."""
+    x = _dequant(data, min_data, max_data)
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    y = (x - moving_mean[None, :, None, None]) * inv[None, :, None, None] \
+        + beta[None, :, None, None]
+    return _requant(y)
+
+
+@_reg(num_outputs=3)
+def quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """Ref: quantization/quantized_elemwise_mul.cc."""
+    y = _dequant(lhs, lhs_min, lhs_max) * _dequant(rhs, rhs_min, rhs_max)
+    return _requant(y)
+
+
+@_reg(num_outputs=3)
+def quantized_embedding(data, weight, min_weight, max_weight,
+                        input_dim=None, output_dim=None, dtype='int8'):
+    """INT8 embedding lookup: rows stay quantized, range passes through
+    (ref: quantization/quantized_indexing_op.cc)."""
+    rows = weight[data.astype(jnp.int32)]
+    return rows, min_weight, max_weight
+
+
+# ---------------------------------------------------------------------------
+# AMP / multi-tensor utilities (ref: src/operator/tensor/amp_cast.cc,
+# contrib/all_finite.cc, contrib/reset_arrays.cc)
+# ---------------------------------------------------------------------------
+
+@_reg
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to a common width: widest by default, narrowest
+    with cast_narrow (ref: amp_cast.cc amp_multicast)."""
+    dtypes = [d.dtype for d in data]
+    key = min if cast_narrow else max
+    target = key(dtypes, key=lambda t: jnp.dtype(t).itemsize)
+    return tuple(d.astype(target) for d in data)
+
+
+@_reg(nograd=True)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """1.0 iff every element of every input is finite
+    (ref: contrib/all_finite.cc multi_all_finite)."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@_reg(nograd=True, mutate_inputs=(0,))
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every input array (ref: contrib/reset_arrays.cc). Functional
+    form: returns the zeroed arrays; the NDArray layer rebinds handles."""
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@_reg(nograd=True)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS learning-rate coefficients from per-layer ‖w‖² and ‖g‖²
+    (ref: contrib/multi_lars.cc multi_lars)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return jnp.where((w_norm > 0) & (g_norm > 0), lrs * trust, lrs)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer update long tail (ref: src/operator/optimizer_op.cc,
+# contrib/optimizer_op.cc, contrib/adamw.cc)
+# ---------------------------------------------------------------------------
+
+def _prep(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight
+    return g
+
+
+@_reg
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Mixed-precision NAG: math in the fp32 master copy, bf16/fp16 view
+    out (ref: optimizer_op.cc mp_nag_mom_update)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd,
+              weight32)
+    new_mom = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * new_mom)
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@_reg
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """LAMB phase 1 on the fp32 master weight
+    (ref: optimizer_op.cc mp_lamb_update_phase1)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    gh = m / (jnp.sqrt(v) + epsilon)
+    if bias_correction:
+        gh = (m / (1 - beta1 ** t)) / \
+            (jnp.sqrt(v / (1 - beta2 ** t)) + epsilon)
+    return gh + wd * weight32, m, v
+
+
+@_reg
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, lr=0.01,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    """LAMB phase 2: trust-ratio scaling applied to the master weight
+    (ref: optimizer_op.cc mp_lamb_update_phase2)."""
+    r1c = r1
+    if lower_bound > 0:
+        r1c = jnp.maximum(r1c, lower_bound)
+    if upper_bound > 0:
+        r1c = jnp.minimum(r1c, upper_bound)
+    ratio = jnp.where(r2 > 0, jnp.where(r1c > 0, r1c / r2, 1.0), 1.0)
+    w32 = weight32 - lr * ratio * g_update
+    return w32.astype(weight.dtype), w32
+
+
+@_reg
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
+                    lr=0.001, eta=1.0, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    wd=0.0, clip_gradient=-1.0):
+    """Mixed-precision AdamW (ref: contrib/adamw.cc _mp_adamw_update)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon)
+                            + lr * wd * weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+@_reg
+def multi_mp_adamw_update(weights, grads, means, vars_, weights32,
+                          rescale_grad=1.0, lrs=(), etas=(), wds=(),
+                          beta1=0.9, beta2=0.999, epsilon=1e-8,
+                          clip_gradient=-1.0):
+    """Multi-tensor mixed-precision AdamW (ref: contrib/adamw.cc
+    _multi_mp_adamw_update)."""
+    outs = []
+    for w, g, m, v, w32, lr, eta, wd in zip(weights, grads, means, vars_,
+                                            weights32, lrs, etas, wds):
+        outs.append(mp_adamw_update(w, g, m, v, w32,
+                                    rescale_grad=rescale_grad, lr=lr,
+                                    eta=eta, beta1=beta1, beta2=beta2,
+                                    epsilon=epsilon, wd=wd,
+                                    clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@_reg
+def multi_mp_lamb_update(weights, grads, means, vars_, weights32, lrs=(),
+                         wds=(), step_count=(), beta1=0.9, beta2=0.999,
+                         epsilon=1e-6, bias_correction=True,
+                         rescale_grad=1.0, lower_bound=-1.0,
+                         upper_bound=-1.0, clip_gradient=-1.0):
+    """Multi-tensor mixed-precision LAMB (ref: contrib/multi_lamb.cc)."""
+    outs = []
+    for w, g, m, v, w32, lr, wd, t in zip(weights, grads, means, vars_,
+                                          weights32, lrs, wds, step_count):
+        gh, m2, v2 = mp_lamb_update_phase1(
+            w, g, m, v, w32, beta1=beta1, beta2=beta2, epsilon=epsilon,
+            t=t, bias_correction=bias_correction, wd=wd,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        r1 = jnp.linalg.norm(w32)
+        r2 = jnp.linalg.norm(gh)
+        wnew, w32n = mp_lamb_update_phase2(
+            w, gh, r1, r2, w32, lr=lr, lower_bound=lower_bound,
+            upper_bound=upper_bound)
+        outs.append((wnew, m2, v2, w32n))
+    return tuple(outs)
+
+
+@_reg
+def sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse AdaGrad: rows with all-zero gradient are untouched
+    (ref: optimizer_op.cc _sparse_adagrad_update; dense payload, the
+    row mask recovers the lazy-update semantics)."""
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    row_nz = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)),
+                     keepdims=True) if grad.ndim > 1 else (grad != 0)
+    new_hist = jnp.where(row_nz, history + jnp.square(g), history)
+    new_w = jnp.where(row_nz,
+                      weight - lr * g / (jnp.sqrt(new_hist) + epsilon),
+                      weight)
+    return new_w, new_hist
+
+
+@_reg
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Group (per-row) AdaGrad — history has shape (rows, 1)
+    (ref: contrib/optimizer_op.cc _contrib_group_adagrad_update)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    msq = jnp.mean(jnp.square(g), axis=axes, keepdims=True)
+    # canonical history is (rows, 1); accept (rows,) or grad-shaped too
+    h = history + msq.reshape((history.shape[0],) +
+                              (1,) * (history.ndim - 1))
+    hb = h.reshape((h.shape[0],) + (1,) * (g.ndim - 1)) if h.ndim == 1 else h
+    return weight - lr * g / (jnp.sqrt(hb) + epsilon), h
+
+
+# ---------------------------------------------------------------------------
+# Random *_like family + unique zipfian
+# (ref: src/operator/random/sample_op.cc:62, unique_sample_op.cc)
+# ---------------------------------------------------------------------------
+
+def _make_like(base_fn, name):
+    def op(data, **kwargs):
+        kwargs.pop('shape', None)
+        return base_fn(shape=data.shape, dtype=str(data.dtype), **kwargs)
+    op.__name__ = name
+    op.__doc__ = (f"Shape/dtype-from-input variant of {base_fn.__name__} "
+                  "(ref: random/sample_op.cc:62 "
+                  "MXNET_OPERATOR_REGISTER_SAMPLE_LIKE).")
+    return op
+
+
+def _register_like_ops():
+    from . import random_ops as rops
+    pairs = [
+        (rops.random_uniform, 'random_uniform_like'),
+        (rops.random_normal, 'random_normal_like'),
+        (rops.random_gamma, 'random_gamma_like'),
+        (rops.random_exponential, 'random_exponential_like'),
+        (rops.random_poisson, 'random_poisson_like'),
+        (rops.random_negative_binomial, 'random_negative_binomial_like'),
+        (rops.random_generalized_negative_binomial,
+         'random_generalized_negative_binomial_like'),
+    ]
+    for base, name in pairs:
+        op = _make_like(base, name)
+        register_op(name, nograd=True)(op)
+        __all__.append(name)
+
+
+_register_like_ops()
+
+
+@_reg(nograd=True, num_outputs=2)
+def sample_unique_zipfian(range_max, shape=()):
+    """Approximately-unique samples from a Zipfian(range_max) distribution,
+    plus the number of trials drawn — the sampled-softmax candidate
+    sampler (ref: random/unique_sample_op.cc _sample_unique_zipfian).
+    Host-side numpy like the reference's CPU-only kernel."""
+    n = int(onp.prod(shape)) if shape else 1
+    rng = onp.random.default_rng(
+        int(jax.device_get(_random.next_key())[-1]))
+    seen, out, tries = set(), [], 0
+    log_range = onp.log(range_max + 1)
+    while len(out) < n:
+        u = rng.random()
+        v = int(onp.exp(u * log_range)) - 1
+        v = min(v, range_max - 1)
+        tries += 1
+        if v not in seen:
+            seen.add(v)
+            out.append(v)
+    arr = onp.asarray(out, dtype=onp.int32).reshape(shape if shape else (1,))
+    return jnp.asarray(arr), jnp.asarray([tries], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Image random-augmentation ops (ref: src/operator/image/image_random.cc)
+# ---------------------------------------------------------------------------
+
+def _u(low, high):
+    return float(jax.device_get(
+        jax.random.uniform(_random.next_key(), (), minval=low, maxval=high)))
+
+
+def _blend(a, b, alpha):
+    return a * alpha + b * (1.0 - alpha)
+
+
+def _to_float(img):
+    return img.astype(jnp.float32)
+
+
+def _gray(img):
+    # HWC or CHW? the reference image ops take HWC (or NHWC)
+    r, g, b = img[..., 0:1], img[..., 1:2], img[..., 2:3]
+    return 0.299 * r + 0.587 * g + 0.114 * b
+
+
+@_reg(nograd=True)
+def image_adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting with explicit alpha
+    (ref: image/image_random.cc _image_adjust_lighting)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.814],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    delta = eigvec @ (alpha * eigval)
+    return (_to_float(data) + delta).astype(data.dtype) \
+        if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.clip(_to_float(data) + delta, 0, 255).astype(data.dtype)
+
+
+@_reg(nograd=True)
+def image_random_lighting(data, alpha_std=0.05):
+    """Ref: image_random.cc _image_random_lighting."""
+    a = jax.device_get(jax.random.normal(_random.next_key(), (3,))) \
+        * alpha_std
+    return image_adjust_lighting(data, tuple(float(x) for x in a))
+
+
+@_reg(nograd=True)
+def image_random_brightness(data, min_factor=0.5, max_factor=1.5):
+    """Scale by U(min, max) (ref: image_random.cc _image_random_brightness)."""
+    f = _u(min_factor, max_factor)
+    out = _to_float(data) * f
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        out = jnp.clip(out, 0, 255)
+    return out.astype(data.dtype)
+
+
+@_reg(nograd=True)
+def image_random_contrast(data, min_factor=0.5, max_factor=1.5):
+    """Blend with the global gray mean (ref: _image_random_contrast)."""
+    f = _u(min_factor, max_factor)
+    x = _to_float(data)
+    mean = jnp.mean(_gray(x))
+    out = _blend(x, mean, f)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        out = jnp.clip(out, 0, 255)
+    return out.astype(data.dtype)
+
+
+@_reg(nograd=True)
+def image_random_saturation(data, min_factor=0.5, max_factor=1.5):
+    """Blend with the per-pixel gray value (ref: _image_random_saturation)."""
+    f = _u(min_factor, max_factor)
+    x = _to_float(data)
+    out = _blend(x, _gray(x), f)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        out = jnp.clip(out, 0, 255)
+    return out.astype(data.dtype)
+
+
+@_reg(nograd=True)
+def image_random_hue(data, min_factor=0.5, max_factor=1.5):
+    """Rotate hue in YIQ space by U(min,max)-derived angle
+    (ref: _image_random_hue)."""
+    f = _u(min_factor, max_factor)
+    x = _to_float(data)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.linalg.inv(t_yiq)
+    u, w_ = onp.cos(f * onp.pi), onp.sin(f * onp.pi)
+    rot = jnp.asarray([[1, 0, 0], [0, u, -w_], [0, w_, u]], jnp.float32)
+    m = t_rgb @ rot @ t_yiq
+    out = jnp.einsum('...c,dc->...d', x, m)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        out = jnp.clip(out, 0, 255)
+    return out.astype(data.dtype)
+
+
+@_reg(nograd=True)
+def image_random_color_jitter(data, brightness=0.0, contrast=0.0,
+                              saturation=0.0, hue=0.0):
+    """Compose brightness/contrast/saturation/hue in random order
+    (ref: _image_random_color_jitter)."""
+    jitters = []
+    if brightness > 0:
+        jitters.append(lambda d: image_random_brightness(
+            d, 1 - brightness, 1 + brightness))
+    if contrast > 0:
+        jitters.append(lambda d: image_random_contrast(
+            d, 1 - contrast, 1 + contrast))
+    if saturation > 0:
+        jitters.append(lambda d: image_random_saturation(
+            d, 1 - saturation, 1 + saturation))
+    if hue > 0:
+        jitters.append(lambda d: image_random_hue(d, -hue, hue))
+    order = onp.random.permutation(len(jitters))
+    for i in order:
+        data = jitters[int(i)](data)
+    return data
+
+
+@_reg(nograd=True)
+def image_random_flip_left_right(data, p=0.5):
+    """Ref: _image_random_flip_left_right."""
+    if _u(0.0, 1.0) < p:
+        return jnp.flip(data, axis=-2)
+    return data
+
+
+@_reg(nograd=True)
+def image_random_flip_top_bottom(data, p=0.5):
+    """Ref: _image_random_flip_top_bottom."""
+    if _u(0.0, 1.0) < p:
+        return jnp.flip(data, axis=-3)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Custom-op dispatch + control flow as registered ops
+# ---------------------------------------------------------------------------
+
+@_reg
+def custom(*data, op_type=None, **kwargs):
+    """Dispatch to a user CustomOpProp registered via mx.operator.register
+    (ref: src/operator/custom/custom.cc Custom). The bridge in
+    operator.py handles trace-time pure_callback + custom_vjp."""
+    from .. import operator as _operator
+    return _operator.invoke_custom(list(data), op_type=op_type, **kwargs)
+
+
+def _register_control_flow():
+    from . import control_flow as cf
+    register_op('cond')(cf.cond)
+    register_op('foreach')(cf.foreach)
+    register_op('while_loop')(cf.while_loop)
+
+
+_register_control_flow()

@@ -178,3 +178,15 @@ def test_custom_multi_output_default_shapes():
     a, b = nd.Custom(nd.array([1.0, 2.0]), op_type='t_twoout')
     assert_almost_equal(a, onp.array([2.0, 4.0]))
     assert_almost_equal(b, onp.array([3.0, 6.0]))
+
+
+def test_registered_custom_op_dispatches_by_op_type():
+    """The registry op `custom` (aliases: `Custom`, `_npi_Custom`) must
+    dispatch to a user prop exactly like nd.Custom (executed-coverage:
+    the registered variant is what Symbol programs hit)."""
+    from mxnet_tpu.base import get_op
+    x = nd.array([0.0, 1.0, -2.0])
+    out = get_op('Custom').fn(x, op_type='t_sigmoid')
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    s = 1 / (1 + onp.exp(-onp.array([0.0, 1.0, -2.0])))
+    assert_almost_equal(out, s, rtol=1e-6)

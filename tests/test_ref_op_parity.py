@@ -1,0 +1,386 @@
+"""Reference op-name parity audit (VERDICT r4 #2).
+
+The fixture tests/fixtures/reference_op_names.txt is the statically
+extracted inventory of every name the reference registers through
+NNVM_REGISTER_OP (directly or via MXNET_OPERATOR_REGISTER_* macros,
+including token-pasted and .add_alias names) — see
+tools/extract_ref_ops.py. This test asserts every single name either
+resolves through our registry (canonical or alias) or appears in the
+explicit descope table with a reason, and pins the counts so a
+regression (an op or alias disappearing) fails loudly.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import get_op, MXNetError
+from mxnet_tpu.ops.ref_aliases import (
+    DESCOPED, is_descoped, resolve_reference_name, reference_op_names)
+
+
+def _fixture_names():
+    # the inventory ships as package data (mxnet_tpu/ops/
+    # reference_op_names.txt) so the runtime aliases don't depend on the
+    # test tree; this suite audits that same copy
+    return reference_op_names()
+
+
+class TestRefOpParity:
+    def test_fixture_is_nontrivial(self):
+        names = _fixture_names()
+        # the reference registers ~533 canonical ops plus aliases and
+        # backward ops; the static sweep finds ~980 names. Guard against
+        # a truncated fixture silently weakening the audit.
+        assert len(names) > 900
+        for landmark in ['FullyConnected', 'Convolution', 'softmax',
+                         '_npi_einsum', '_random_uniform', 'BatchNorm',
+                         '_contrib_arange_like', 'sgd_update']:
+            assert landmark in names, landmark
+
+    def test_every_reference_name_resolves_or_is_descoped(self):
+        unresolved = []
+        for n in _fixture_names():
+            if is_descoped(n):
+                continue
+            if resolve_reference_name(n) is None:
+                unresolved.append(n)
+        assert unresolved == [], (
+            f'{len(unresolved)} reference op names neither resolve nor '
+            f'appear in the descope table: {unresolved[:20]}...')
+
+    def test_resolved_names_actually_invoke_through_get_op(self):
+        # resolve_reference_name is the audit's map; get_op is the
+        # runtime path. Aliases must be installed, not just derivable.
+        for n in ['FullyConnected', 'Activation', '_Plus', 'uniform',
+                  'BlockGrad', '_npx_relu', 'ElementWiseSum', 'crop',
+                  '_contrib_ROIAlign', 'choose_element_0index',
+                  '_random_normal_like', '_cond', 'Custom']:
+            od = get_op(n)
+            assert callable(od.fn), n
+
+    def test_descope_reasons_are_present(self):
+        for name, reason in DESCOPED.items():
+            assert isinstance(reason, str) and len(reason) > 10, name
+
+    def test_pinned_counts(self):
+        names = _fixture_names()
+        resolved = sum(1 for n in names
+                       if not is_descoped(n)
+                       and resolve_reference_name(n) is not None)
+        descoped = sum(1 for n in names if is_descoped(n))
+        assert resolved + descoped == len(names)
+        # pins (update deliberately when the fixture regenerates):
+        assert resolved >= 730, resolved
+        assert descoped <= 250, descoped
+
+    def test_backward_names_all_descoped_by_vjp_rule(self):
+        for n in _fixture_names():
+            if n.startswith('_backward_'):
+                assert is_descoped(n)
+
+
+class TestRefCompatOps:
+    """Numeric checks for the ops the audit forced into existence."""
+
+    def test_stop_gradient_blocks(self):
+        import jax
+        import jax.numpy as jnp
+        g = jax.grad(lambda x: jnp.sum(get_op('stop_gradient').fn(x) * x))(
+            jnp.ones(3))
+        onp.testing.assert_allclose(g, onp.ones(3))  # only the outer x
+
+    def test_round_half_away_from_zero(self):
+        import jax.numpy as jnp
+        out = get_op('round').fn(jnp.asarray([-2.5, -0.5, 0.5, 1.5, 2.5]))
+        onp.testing.assert_allclose(out, [-3., -1., 1., 2., 3.])
+
+    def test_reshape_like(self):
+        import jax.numpy as jnp
+        lhs = jnp.arange(6.0)
+        out = get_op('reshape_like').fn(lhs, jnp.zeros((2, 3)))
+        assert out.shape == (2, 3)
+        out = get_op('reshape_like').fn(
+            jnp.zeros((30, 7)), jnp.zeros((15, 2, 4)),
+            lhs_begin=0, lhs_end=1, rhs_begin=0, rhs_end=2)
+        assert out.shape == (15, 2, 7)
+
+    def test_split_v2(self):
+        import jax.numpy as jnp
+        parts = get_op('_split_v2').fn(jnp.arange(10), indices=(3, 7))
+        assert [p.shape[0] for p in parts] == [3, 4, 3]
+
+    def test_slice_assign_matches_numpy(self):
+        import jax.numpy as jnp
+        x = onp.zeros((4, 5), onp.float32)
+        x[1:3, 2:4] = 7
+        out = get_op('_slice_assign_scalar').fn(
+            jnp.zeros((4, 5)), scalar=7, begin=(1, 2), end=(3, 4))
+        onp.testing.assert_allclose(out, x)
+
+    def test_im2col_col2im_roundtrip_counts(self):
+        import jax.numpy as jnp
+        x = jnp.arange(1 * 2 * 4 * 4, dtype=jnp.float32).reshape(1, 2, 4, 4)
+        cols = get_op('im2col').fn(x, kernel=(2, 2), stride=(2, 2))
+        assert cols.shape == (1, 2 * 2 * 2, 4)
+        back = get_op('col2im').fn(cols, output_size=(4, 4), kernel=(2, 2),
+                                   stride=(2, 2))
+        # non-overlapping stride → col2im(im2col(x)) == x exactly
+        onp.testing.assert_allclose(back, x)
+
+    def test_linalg_gelqf(self):
+        import jax.numpy as jnp
+        a = onp.random.RandomState(0).randn(3, 5).astype(onp.float32)
+        l_mat, q = get_op('_linalg_gelqf').fn(jnp.asarray(a))
+        onp.testing.assert_allclose(onp.asarray(l_mat @ q), a, atol=1e-5)
+        onp.testing.assert_allclose(onp.asarray(q @ q.T), onp.eye(3),
+                                    atol=1e-5)
+        assert (onp.diagonal(l_mat) >= 0).all()
+
+    def test_linalg_syevd(self):
+        import jax.numpy as jnp
+        rs = onp.random.RandomState(1)
+        m = rs.randn(4, 4).astype(onp.float32)
+        a = (m + m.T) / 2
+        u, lam = get_op('_linalg_syevd').fn(jnp.asarray(a))
+        recon = onp.asarray(u).T @ onp.diag(onp.asarray(lam)) @ onp.asarray(u)
+        onp.testing.assert_allclose(recon, a, atol=1e-4)
+
+    def test_linalg_triangle_roundtrip(self):
+        import jax.numpy as jnp
+        a = jnp.asarray(onp.random.RandomState(2).randn(4, 4)
+                        .astype(onp.float32))
+        packed = get_op('_linalg_extracttrian').fn(a, offset=0, lower=True)
+        assert packed.shape == (10,)
+        tri = get_op('_linalg_maketrian').fn(packed, offset=0, lower=True)
+        onp.testing.assert_allclose(onp.asarray(tri),
+                                    onp.tril(onp.asarray(a)), atol=1e-6)
+
+    def test_regression_outputs(self):
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray([0.0, 1.0, -1.0])
+        y = jnp.asarray([0.5, 0.5, 0.5])
+        lin = get_op('LinearRegressionOutput').fn
+        out = lin(x, y)
+        onp.testing.assert_allclose(out, x)
+        g = jax.grad(lambda d: jnp.sum(lin(d, y)))(x)
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(x - y),
+                                    atol=1e-6)
+        logi = get_op('LogisticRegressionOutput').fn
+        g2 = jax.grad(lambda d: jnp.sum(logi(d, y)))(x)
+        onp.testing.assert_allclose(onp.asarray(g2),
+                                    onp.asarray(jax.nn.sigmoid(x) - y),
+                                    atol=1e-6)
+        mae = get_op('MAERegressionOutput').fn
+        g3 = jax.grad(lambda d: jnp.sum(mae(d, y)))(x)
+        onp.testing.assert_allclose(onp.asarray(g3),
+                                    onp.sign(onp.asarray(x - y)), atol=1e-6)
+
+    def test_roi_pooling(self):
+        import jax.numpy as jnp
+        # 1x1x4x4 ramp; one ROI covering the full image, 2x2 bins
+        data = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        rois = jnp.asarray([[0, 0, 0, 3, 3]], jnp.float32)
+        out = get_op('ROIPooling').fn(data, rois, pooled_size=(2, 2),
+                                      spatial_scale=1.0)
+        onp.testing.assert_allclose(
+            onp.asarray(out)[0, 0], [[5., 7.], [13., 15.]])
+
+    def test_rroi_align_axis_aligned_matches_mean(self):
+        import jax.numpy as jnp
+        data = jnp.ones((1, 3, 8, 8), jnp.float32)
+        rois = jnp.asarray([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], jnp.float32)
+        out = get_op('_contrib_RROIAlign').fn(data, rois,
+                                              pooled_size=(2, 2))
+        assert out.shape == (1, 3, 2, 2)
+        onp.testing.assert_allclose(onp.asarray(out), 1.0, atol=1e-5)
+
+    def test_bipartite_matching(self):
+        import jax.numpy as jnp
+        score = jnp.asarray([[0.9, 0.1], [0.8, 0.7]])
+        rows, cols = get_op('_contrib_bipartite_matching').fn(
+            score, is_ascend=False, threshold=0.05)
+        # greedy: (0,0)=0.9 first, then (1,1)=0.7
+        onp.testing.assert_allclose(onp.asarray(rows), [0., 1.])
+        onp.testing.assert_allclose(onp.asarray(cols), [0., 1.])
+
+    def test_multi_lars(self):
+        import jax.numpy as jnp
+        lrs = jnp.asarray([0.1, 0.1])
+        w2 = jnp.asarray([4.0, 0.0])
+        g2 = jnp.asarray([1.0, 1.0])
+        wds = jnp.asarray([0.0, 0.0])
+        out = get_op('multi_lars').fn(lrs, w2, g2, wds, eta=1.0, eps=0.0)
+        onp.testing.assert_allclose(onp.asarray(out), [0.2, 0.1], atol=1e-6)
+
+    def test_group_adagrad_shapes_and_math(self):
+        import jax.numpy as jnp
+        w = jnp.ones((3, 4))
+        g = jnp.ones((3, 4))
+        h = jnp.zeros((3, 1))
+        w2, h2 = get_op('_contrib_group_adagrad_update').fn(
+            w, g, h, lr=1.0, epsilon=0.0)
+        onp.testing.assert_allclose(onp.asarray(h2), 1.0)
+        onp.testing.assert_allclose(onp.asarray(w2), 0.0, atol=1e-6)
+
+    def test_sparse_adagrad_skips_zero_rows(self):
+        import jax.numpy as jnp
+        w = jnp.ones((2, 3))
+        g = jnp.asarray([[1.0, 1.0, 1.0], [0.0, 0.0, 0.0]])
+        h = jnp.zeros((2, 3))
+        w2, h2 = get_op('_sparse_adagrad_update').fn(w, g, h, lr=0.5,
+                                                     epsilon=0.0)
+        assert (onp.asarray(w2)[1] == 1.0).all()      # untouched row
+        assert (onp.asarray(w2)[0] < 1.0).all()       # updated row
+
+    def test_mp_updates_master_weight_precision(self):
+        import jax.numpy as jnp
+        w = jnp.ones((4,), jnp.bfloat16)
+        w32 = jnp.ones((4,), jnp.float32)
+        g = jnp.full((4,), 0.125, jnp.bfloat16)
+        mom = jnp.zeros((4,), jnp.float32)
+        nw, nmom, nw32 = get_op('mp_nag_mom_update').fn(
+            w, g, mom, w32, lr=0.1, momentum=0.9)
+        assert nw.dtype == jnp.bfloat16 and nw32.dtype == jnp.float32
+        m, v = jnp.zeros((4,), jnp.float32), jnp.zeros((4,), jnp.float32)
+        nw, nm, nv, nw32 = get_op('_mp_adamw_update').fn(
+            w, g, m, v, w32, lr=0.1)
+        assert nw.dtype == jnp.bfloat16 and nw32.dtype == jnp.float32
+
+    def test_amp_multicast_widest(self):
+        import jax.numpy as jnp
+        a = jnp.ones(3, jnp.bfloat16)
+        b = jnp.ones(3, jnp.float32)
+        oa, ob = get_op('amp_multicast').fn(a, b)
+        assert oa.dtype == jnp.float32 and ob.dtype == jnp.float32
+        na, nb = get_op('amp_multicast').fn(a, b, cast_narrow=True)
+        assert na.dtype == jnp.bfloat16 and nb.dtype == jnp.bfloat16
+
+    def test_multi_all_finite_and_reset_arrays(self):
+        import jax.numpy as jnp
+        ok = get_op('multi_all_finite').fn(jnp.ones(3), jnp.zeros(2))
+        assert float(ok[0]) == 1.0
+        bad = get_op('multi_all_finite').fn(jnp.asarray([onp.inf]))
+        assert float(bad[0]) == 0.0
+        z = get_op('reset_arrays').fn(jnp.ones(3), jnp.ones((2, 2)))
+        assert all(float(onp.asarray(x).sum()) == 0 for x in z)
+
+    def test_square_sum_and_argmax_channel(self):
+        import jax.numpy as jnp
+        x = jnp.asarray([[1.0, 2.0], [3.0, 0.0]])
+        onp.testing.assert_allclose(
+            float(get_op('_square_sum').fn(x)), 14.0)
+        onp.testing.assert_allclose(
+            onp.asarray(get_op('argmax_channel').fn(x)), [1., 0.])
+
+    def test_index_array_and_getnnz(self):
+        import jax.numpy as jnp
+        x = jnp.zeros((2, 3))
+        idx = get_op('_contrib_index_array').fn(x)
+        assert idx.shape == (2, 3, 2)
+        assert int(idx[1, 2, 0]) == 1 and int(idx[1, 2, 1]) == 2
+        nnz = get_op('_contrib_getnnz').fn(jnp.asarray([[1.0, 0.0],
+                                                        [2.0, 3.0]]))
+        assert int(nnz) == 3
+
+    def test_random_like_family(self):
+        import jax.numpy as jnp
+        x = jnp.zeros((3, 4), jnp.float32)
+        for name in ['_random_uniform_like', '_random_normal_like',
+                     '_random_gamma_like', '_random_exponential_like',
+                     '_random_poisson_like',
+                     '_random_negative_binomial_like',
+                     '_random_generalized_negative_binomial_like']:
+            out = get_op(name).fn(x)
+            assert out.shape == x.shape, name
+
+    def test_sample_unique_zipfian(self):
+        samples, tries = get_op('_sample_unique_zipfian').fn(
+            1000, shape=(16,))
+        arr = onp.asarray(samples)
+        assert arr.shape == (16,)
+        assert len(set(arr.tolist())) == 16           # unique
+        assert (arr >= 0).all() and (arr < 1000).all()
+        assert int(tries[0]) >= 16
+
+    def test_image_random_ops_smoke(self):
+        import jax.numpy as jnp
+        img = jnp.ones((8, 8, 3), jnp.float32) * 0.5
+        for name in ['_image_random_brightness', '_image_random_contrast',
+                     '_image_random_saturation', '_image_random_hue',
+                     '_image_random_lighting']:
+            out = get_op(name).fn(img)
+            assert out.shape == img.shape, name
+        out = get_op('_image_random_color_jitter').fn(
+            img, brightness=0.2, contrast=0.2, saturation=0.2, hue=0.1)
+        assert out.shape == img.shape
+        for name in ['_image_random_flip_left_right',
+                     '_image_random_flip_top_bottom']:
+            assert get_op(name).fn(img).shape == img.shape
+
+    def test_quantized_variants_smoke(self):
+        import jax.numpy as jnp
+        q = jnp.asarray([[-120, 0], [60, 127]], jnp.int8)
+        mn, mx = jnp.float32(-1.0), jnp.float32(1.0)
+        out, omn, omx = get_op('_contrib_quantized_act').fn(q, mn, mx)
+        assert out.dtype == jnp.int8 and (onp.asarray(out) >= 0).all()
+        w = jnp.asarray(onp.random.RandomState(3)
+                        .randint(-127, 127, (10, 4)), jnp.int8)
+        rows, rmn, rmx = get_op('_contrib_quantized_embedding').fn(
+            jnp.asarray([1, 3]), w, mn, mx)
+        assert rows.shape == (2, 4) and rows.dtype == jnp.int8
+        y, ymn, ymx = get_op('_contrib_quantized_elemwise_mul').fn(
+            q, q, mn, mx, mn, mx)
+        assert y.dtype == jnp.int8
+
+    def test_calibrate_entropy_returns_threshold(self):
+        hist = onp.concatenate([onp.full(100, 10.0), [1.0, 1.0]])
+        edges = onp.linspace(-5, 5, 103)
+        t, d = get_op('_contrib_calibrate_entropy').fn(hist, edges,
+                                                       num_quantized_bins=51)
+        assert 0 < float(t) <= 5.0
+        assert float(d) >= 0
+
+    def test_identity_attach_kl_sparse_reg_grad(self):
+        import jax
+        import jax.numpy as jnp
+        op = get_op('IdentityAttachKLSparseReg').fn
+        x = jnp.full((4, 2), 0.2)
+        out = op(x)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(x))
+        g = jax.grad(lambda d: jnp.sum(op(d)))(x)
+        # rho_hat == target=0.1? rho_hat=0.2 → penalty grad nonzero
+        assert not onp.allclose(onp.asarray(g), 1.0)
+
+    def test_scatter_set_nd(self):
+        import jax.numpy as jnp
+        lhs = jnp.zeros((3, 3))
+        idx = jnp.asarray([[0, 2], [1, 0]])   # rows: dim0 indices, dim1
+        rhs = jnp.asarray([5.0, 6.0])
+        out = get_op('_scatter_set_nd').fn(lhs, rhs, idx)
+        assert float(out[0, 1]) == 5.0 and float(out[2, 0]) == 6.0
+
+    def test_multi_mp_updates(self):
+        import jax.numpy as jnp
+        n = 2
+        ws = [jnp.ones((3,), jnp.bfloat16) for _ in range(n)]
+        gs = [jnp.full((3,), 0.25, jnp.bfloat16) for _ in range(n)]
+        ms = [jnp.zeros((3,), jnp.float32) for _ in range(n)]
+        vs = [jnp.zeros((3,), jnp.float32) for _ in range(n)]
+        w32 = [jnp.ones((3,), jnp.float32) for _ in range(n)]
+        outs = get_op('_multi_mp_adamw_update').fn(
+            ws, gs, ms, vs, w32, lrs=(0.1, 0.1), etas=(1.0, 1.0),
+            wds=(0.0, 0.01))
+        assert len(outs) == n
+        for w_new, m_new, v_new, w32_new in outs:
+            assert w_new.dtype == jnp.bfloat16
+            assert float(onp.asarray(w32_new.astype(onp.float32))[0]) < 1.0
+        louts = get_op('_multi_mp_lamb_update').fn(
+            ws, gs, ms, vs, w32, lrs=(0.1, 0.1), wds=(0.0, 0.0),
+            step_count=(1, 1))
+        assert len(louts) == n
+        for w_new, m_new, v_new, w32_new in louts:
+            assert w_new.dtype == jnp.bfloat16
+            assert float(onp.asarray(w32_new.astype(onp.float32))[0]) < 1.0
