@@ -62,12 +62,21 @@ class NDArray:
         import copy as _copy
         new = object.__new__(type(self))
         memo[id(self)] = new
-        for k in self.__slots__:
-            if k == '__weakref__':
-                continue
-            v = getattr(self, k)
-            # jax.Arrays are immutable: share the buffer instead of copying
-            setattr(new, k, v if k == '_data' else _copy.deepcopy(v, memo))
+        # walk the MRO: self.__slots__ alone would miss inherited slots
+        # on sparse subclasses
+        for klass in type(self).__mro__:
+            for k in getattr(klass, '__slots__', ()):
+                if k == '__weakref__':
+                    continue
+                v = getattr(self, k, None)
+                # jax.Arrays are immutable: share the buffer; caches
+                # (weakref-keyed) reset instead of deep-copying
+                if k == '_data':
+                    setattr(new, k, v)
+                elif k.endswith('_cache'):
+                    setattr(new, k, None)
+                else:
+                    setattr(new, k, _copy.deepcopy(v, memo))
         return new
 
     # ---- basic properties -------------------------------------------------

@@ -12,6 +12,8 @@ arrays is exact and runs the dense path.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax.numpy as jnp
 import numpy as onp
 
@@ -20,7 +22,27 @@ from .ndarray import NDArray, array as _dense_array
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ()
+    # compressed-parts cache: (weakref-to-payload, parts tuple). The
+    # mutable-handle NDArray layer rebinds self._data on every mutation,
+    # so a dead/mismatched weakref means the payload changed and the
+    # parts must be recomputed — one computation per payload mutation.
+    __slots__ = ('_nnz_cache', '_parts_cache')
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx)
+        self._nnz_cache = None
+        self._parts_cache = None
+
+    def _cached_parts(self, compute):
+        cache = self._parts_cache
+        if cache is not None and cache[0]() is self._data:
+            return cache[1]
+        parts = compute()
+        try:
+            self._parts_cache = (weakref.ref(self._data), parts)
+        except TypeError:  # payload type without weakref support
+            self._parts_cache = None
+        return parts
 
     def asnumpy(self):
         return super().asnumpy()
@@ -30,42 +52,40 @@ class BaseSparseNDArray(NDArray):
         a = self.asnumpy()
         return float((a != 0).sum()) / max(1, a.size)
 
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix (ref: sparse.py:300)."""
-    __slots__ = ('_nnz_cache',)  # (payload id, nnz) for sparse dispatch
+    __slots__ = ()
 
     def __init__(self, data, ctx=None):
         super().__init__(data, ctx)
         self._stype = 'csr'
 
     def _csr_parts(self):
-        a = self.asnumpy()
-        rows, cols = onp.nonzero(a)
-        data = a[rows, cols]
-        counts = onp.bincount(rows, minlength=a.shape[0])
-        indptr = onp.concatenate([[0], onp.cumsum(counts)])
-        return (data.astype(a.dtype), cols.astype(onp.int64),
-                indptr.astype(onp.int64))
+        def compute():
+            a = self.asnumpy()
+            rows, cols = onp.nonzero(a)
+            data = a[rows, cols]
+            counts = onp.bincount(rows, minlength=a.shape[0])
+            indptr = onp.concatenate([[0], onp.cumsum(counts)])
+            return (data.astype(a.dtype), cols.astype(onp.int64),
+                    indptr.astype(onp.int64))
+        return self._cached_parts(compute)
 
     @property
     def data(self):
         return _dense_array(self._csr_parts()[0])
 
     @property
+    def indices(self):
+        return _dense_array(self._csr_parts()[1])
+
+    @property
     def indptr(self):
         return _dense_array(self._csr_parts()[2])
-
-    def tostype(self, stype):
-        return cast_storage(self, stype)
-
-
-# fix the broken indices property above cleanly
-def _csr_indices(self):
-    return _dense_array(self._csr_parts()[1])
-
-
-CSRNDArray.indices = property(_csr_indices)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -76,23 +96,24 @@ class RowSparseNDArray(BaseSparseNDArray):
         super().__init__(data, ctx)
         self._stype = 'row_sparse'
 
+    def _rsp_parts(self):
+        def compute():
+            a = self.asnumpy()
+            flat = a.reshape(a.shape[0], -1)
+            nz = onp.nonzero((flat != 0).any(axis=1))[0].astype(onp.int64)
+            return (a[nz], nz)
+        return self._cached_parts(compute)
+
     @property
     def indices(self):
-        a = self.asnumpy().reshape(self.shape[0], -1)
-        nz = onp.nonzero((a != 0).any(axis=1))[0]
-        return _dense_array(nz.astype(onp.int64))
+        return _dense_array(self._rsp_parts()[1])
 
     @property
     def data(self):
-        a = self.asnumpy()
-        nz = onp.asarray(self.indices.asnumpy(), onp.int64)
-        return _dense_array(a[nz])
+        return _dense_array(self._rsp_parts()[0])
 
     def retain(self, indices):
         return retain(self, indices)
-
-    def tostype(self, stype):
-        return cast_storage(self, stype)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype='float32'):
