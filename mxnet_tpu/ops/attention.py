@@ -184,22 +184,34 @@ def multi_head_attention(query, key, value, mask=None, num_heads=1,
 
     if _seq_parallel:
         Tk = k.shape[2]
-        routable = (not apply_dropout and Tq == Tk
-                    and (mask is None or kpm is not None))
+        # dropout no longer blocks the ring route: the ring kernel
+        # regenerates the keep mask in-kernel from global coordinates
+        # (same counter-based PRNG as the Pallas flash kernel), so the
+        # flagship config (dropout=0.1) rides sequence parallelism
+        routable = (Tq == Tk and (mask is None or kpm is not None))
         sp_mesh, sp_axis = _seq_parallel[-1]
         if routable and Tq % sp_mesh.shape[sp_axis] != 0:
             routable = False
         if routable:
             from ..parallel.ring_attention import ring_attention
+            ring_kwargs = {}
+            if apply_dropout:
+                key_ = dropout_key if dropout_key is not None \
+                    else _random.next_key()
+                ring_kwargs = dict(
+                    dropout_p=dropout_p,
+                    dropout_seed=jax.random.bits(key_, (1,), jnp.uint32))
             out = ring_attention(q, k, v, sp_mesh, sp_axis=sp_axis,
-                                 causal=causal, key_mask=kpm)
+                                 causal=causal, key_mask=kpm,
+                                 **ring_kwargs)
             route_counts['ring'] += 1
             return out.transpose(0, 2, 1, 3).reshape(N, Tq, tot)
-        # inside the context but unroutable (dropout active, cross
-        # attention, per-query mask, indivisible T): fall through to the
-        # dense path — loudly, because the user asked for ring attention
+        # inside the context but unroutable (cross attention, per-query
+        # mask, indivisible T): fall through to the dense path — loudly,
+        # because the user asked for ring attention
         import warnings
-        reason = 'attention dropout is active' if apply_dropout else             'cross-attention / per-query mask / sequence length not '             'divisible by the sp axis'
+        reason = ('cross-attention / per-query mask / sequence length '
+                  'not divisible by the sp axis')
         warnings.warn(
             f"sequence_parallel: falling back to dense attention "
             f"({reason}); the T x T score tensor will be materialized.",

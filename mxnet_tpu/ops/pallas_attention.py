@@ -140,8 +140,17 @@ def _dropout_keep(seed, bh, q_base, k_base, bq, bk, rate):
     rows every 2^32/stride queries)."""
     rows = q_base + lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
     cols = k_base + lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+    return _counter_keep(seed, bh.astype(jnp.uint32), rows, cols, rate)
+
+
+def _counter_keep(seed, bh, rows, cols, rate):
+    """The shared hash core: keep/(1-rate) multipliers from broadcastable
+    uint32 (bh, rows, cols) index arrays. Used by the Pallas kernels via
+    _dropout_keep and by ring attention (parallel/ring_attention.py) with
+    GLOBAL sequence positions, so both regenerate identical masks from
+    coordinates alone."""
     h = rows * jnp.uint32(0x9E3779B1) + cols
-    h = h + bh.astype(jnp.uint32) * jnp.uint32(0x9e3779b9)
+    h = h + bh * jnp.uint32(0x9e3779b9)
     h = h ^ seed
     h = h ^ (h >> jnp.uint32(16))
     h = h * jnp.uint32(0x85ebca6b)
