@@ -121,18 +121,22 @@ def init(target_dtype='bfloat16'):
     _patch_epoch += 1
 
     from .. import ndarray as ndmod
-    for name in lists.LP16_OPS:
-        if hasattr(ndmod, name):
+    # full-registry policies (hand lists are overrides inside
+    # derive_policy); patch every op that surfaces in the nd namespace
+    table = lists.policy_table()
+    for name, pol in sorted(table.items()):
+        if not hasattr(ndmod, name):
+            continue
+        if pol == 'lp16':
             _originals[name] = getattr(ndmod, name)
             setattr(ndmod, name, _wrap_lp16(_originals[name], target_dtype))
-    for name in lists.FP32_OPS:
-        if hasattr(ndmod, name):
+        elif pol == 'fp32':
             _originals[name] = getattr(ndmod, name)
             setattr(ndmod, name, _wrap_fp32(_originals[name]))
-    for name in lists.WIDEST_OPS:
-        if hasattr(ndmod, name):
+        elif pol == 'widest':
             _originals[name] = getattr(ndmod, name)
             setattr(ndmod, name, _wrap_widest(_originals[name]))
+        # 'passthrough' / 'nofloat': explicitly untouched
     _amp_initialized = True
 
 
