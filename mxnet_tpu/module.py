@@ -300,6 +300,30 @@ class Module(BaseModule):
             return
         if isinstance(optimizer, str):
             optimizer_params = dict(optimizer_params)
+            # the reference normalizes summed DP gradients by the global
+            # batch size unless the caller overrides rescale_grad
+            # (ref: python/mxnet/module/module.py:527-537 init_optimizer)
+            if 'rescale_grad' not in optimizer_params:
+                batch = 0
+                if self.binded:
+                    for name in self._data_names:
+                        shape = self._data_shapes.get(name)
+                        if shape:
+                            batch = shape[0]
+                            break
+                if batch:
+                    optimizer_params['rescale_grad'] = 1.0 / batch
+                else:
+                    # same warning the reference emits when it cannot
+                    # normalize (init before bind, or bound data shapes
+                    # carry no batch dimension)
+                    why = ('init_optimizer called before bind'
+                           if not self.binded else
+                           'bound data shapes have no usable batch size')
+                    self.logger.warning(
+                        '%s: cannot infer batch size, rescale_grad stays '
+                        '1.0 — gradients will NOT be normalized by batch '
+                        'size', why)
             optimizer = opt_mod.create(optimizer, **optimizer_params)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)

@@ -22,7 +22,7 @@ def _linreg_problem():
     it = io.NDArrayIter(X, Y, batch_size=20, label_name='lin_label')
     mod.init_params(mx.init.Normal(0.1))
     mod.init_optimizer(optimizer='sgd',
-                       optimizer_params=(('learning_rate', 0.05),))
+                       optimizer_params=(('learning_rate', 0.05), ('rescale_grad', 1.0)))
     return mod, it, X, Y
 
 
@@ -59,5 +59,5 @@ def test_svrg_full_grads_snapshot():
 def test_svrg_fit_loop():
     mod, it, X, Y = _linreg_problem()
     mod.fit(it, eval_metric='mse', optimizer='sgd',
-            optimizer_params=(('learning_rate', 0.05),), num_epoch=4)
+            optimizer_params=(('learning_rate', 0.05), ('rescale_grad', 1.0)), num_epoch=4)
     assert _loss(mod, X, Y) < 0.2

