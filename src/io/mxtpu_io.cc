@@ -99,8 +99,15 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 // Decodes JPEG to RGB u8 HWC. Returns false on failure.
+// target_short > 0 enables decode-time scaling: libjpeg's M/8 IDCT
+// scaling decodes directly at reduced resolution, so a 360x480 source
+// headed for resize_short=256 never pays for full-res IDCT — the same
+// trick behind the reference's ~3000 img/s OpenCV path (cv::IMREAD +
+// JPEG scale_denom; ref: src/io/image_recordio pipeline,
+// docs note_data_loading.md:181).
 bool decode_jpeg(const uint8_t* src, size_t len,
-                 std::vector<uint8_t>* out, int* h, int* w) {
+                 std::vector<uint8_t>* out, int* h, int* w,
+                 int target_short = 0) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -116,43 +123,69 @@ bool decode_jpeg(const uint8_t* src, size_t len,
     return false;
   }
   cinfo.out_color_space = JCS_RGB;
+  if (target_short > 0) {
+    int shorter = std::min<int>(cinfo.image_height, cinfo.image_width);
+    if (shorter > target_short) {
+      // largest M/8 (M in 1..8) whose result still covers target_short
+      int m = 8;
+      while (m > 1 && (shorter * (m - 1)) / 8 >= target_short) --m;
+      cinfo.scale_num = m;
+      cinfo.scale_denom = 8;
+      // approximations are fine here: a bilinear resize follows, which
+      // washes out IFAST/plain-upsampling error. The unscaled path
+      // keeps ISLOW + fancy upsampling for exact-decode parity
+      // (tests/test_io_native.py decode_correct).
+      cinfo.dct_method = JDCT_IFAST;
+      cinfo.do_fancy_upsampling = FALSE;
+    }
+  }
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
   out->resize(size_t(*w) * (*h) * 3);
+  // hand libjpeg a whole batch of row pointers per call — per-scanline
+  // calls pay the library's dispatch overhead height times
+  std::vector<uint8_t*> rows(*h);
+  for (int y = 0; y < *h; ++y)
+    rows[y] = out->data() + size_t(y) * (*w) * 3;
   while (cinfo.output_scanline < cinfo.output_height) {
-    uint8_t* row = out->data() + size_t(cinfo.output_scanline) * (*w) * 3;
-    jpeg_read_scanlines(&cinfo, &row, 1);
+    jpeg_read_scanlines(&cinfo, rows.data() + cinfo.output_scanline,
+                        cinfo.output_height - cinfo.output_scanline);
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
   return true;
 }
 
-// Bilinear RGB u8 HWC resize.
+// Bilinear RGB u8 HWC resize. Horizontal coordinates/weights are
+// precomputed once (fixed-point 8.8) instead of per pixel-channel.
 void resize_bilinear(const uint8_t* src, int sh, int sw,
                      uint8_t* dst, int dh, int dw) {
   const float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
   const float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  std::vector<int> x0s(dw), x1s(dw), wxs(dw);
+  for (int x = 0; x < dw; ++x) {
+    float fx = rx * x;
+    int x0 = int(fx);
+    x0s[x] = x0;
+    x1s[x] = std::min(x0 + 1, sw - 1);
+    wxs[x] = int((fx - x0) * 256.f + 0.5f);
+  }
   for (int y = 0; y < dh; ++y) {
     float fy = ry * y;
     int y0 = int(fy);
     int y1 = std::min(y0 + 1, sh - 1);
-    float wy = fy - y0;
+    int wy = int((fy - y0) * 256.f + 0.5f);
+    const uint8_t* r0 = src + size_t(y0) * sw * 3;
+    const uint8_t* r1 = src + size_t(y1) * sw * 3;
+    uint8_t* drow = dst + size_t(y) * dw * 3;
     for (int x = 0; x < dw; ++x) {
-      float fx = rx * x;
-      int x0 = int(fx);
-      int x1 = std::min(x0 + 1, sw - 1);
-      float wx = fx - x0;
+      const int o0 = x0s[x] * 3, o1 = x1s[x] * 3, wx = wxs[x];
       for (int c = 0; c < 3; ++c) {
-        float v00 = src[(size_t(y0) * sw + x0) * 3 + c];
-        float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
-        float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
-        float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
-        float top = v00 + (v01 - v00) * wx;
-        float bot = v10 + (v11 - v10) * wx;
-        dst[(size_t(y) * dw + x) * 3 + c] =
-            uint8_t(top + (bot - top) * wy + 0.5f);
+        int top = (r0[o0 + c] << 8) + (r0[o1 + c] - r0[o0 + c]) * wx;
+        int bot = (r1[o0 + c] << 8) + (r1[o1 + c] - r1[o0 + c]) * wx;
+        drow[x * 3 + c] =
+            uint8_t(((top << 8) + (bot - top) * wy + (1 << 15)) >> 16);
       }
     }
   }
@@ -269,7 +302,12 @@ bool process_record(Pipeline* p, const std::vector<char>& rec, Batch* b,
 
   std::vector<uint8_t> img;
   int h = 0, w = 0;
-  if (!decode_jpeg(payload, payload_len, &img, &h, &w)) return false;
+  // decode-time scaling only when a resize step follows: the scaled
+  // decode feeds the same resize_bilinear, so output semantics are
+  // unchanged; without resize_short, crops must come from the full-res
+  // image, so decode full size
+  if (!decode_jpeg(payload, payload_len, &img, &h, &w, c.resize_short))
+    return false;
 
   if (c.resize_short > 0) {
     int shorter = std::min(h, w);
