@@ -174,3 +174,134 @@ def bert_pretrain_loss(mlm_logits, nsp_logits, labels, nsp_labels,
     nsp_logp = nd.log_softmax(nsp_logits, axis=-1)
     nsp_loss = nd.mean(-nd.pick(nsp_logp, nsp_labels, axis=-1))
     return mlm_loss + nsp_loss
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel bridge (VERDICT r4 #6): express the Gluon BERT as the
+# embed → encoder-stages → head split that parallel/pipeline.py
+# pipelines over a 'pp' mesh axis. The functional stage math mirrors
+# BertLayer.forward exactly (eval mode — GPipe microbatching assumes
+# deterministic stages), so a pipelined step is parity-comparable
+# against the same Gluon model on the pure-DP path.
+# ---------------------------------------------------------------------------
+
+def _p(param):
+    """A Gluon Parameter's jax payload."""
+    return param.data()._data
+
+
+def bert_pipeline_funcs(model: 'BertForPretraining', n_stages,
+                        mesh=None, pp_axis='pp'):
+    """Extract (params, embed_fn, stage_fn, head_fn, loss_fn) for
+    parallel.PipelineTrainStep from an initialized BertForPretraining.
+
+    The encoder's layers split evenly into `n_stages` pipeline stages
+    (layers % n_stages == 0); embedding and the MLM/NSP heads replicate
+    outside the pipeline.
+
+    Constraints (validated, not assumed): the model must be built with
+    dropout=0 — GPipe microbatch stages must be deterministic — and the
+    pipelined forward is the token_types=None path (type_embed gets no
+    gradient on the DP path either when token_types is never fed, so the
+    two paths train the same weights).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    from ..ops import nn as F
+    from ..ops import attention as attn_ops
+    from ..parallel.pipeline import split_layers_into_stages
+
+    bert = model.bert
+    heads = bert.encoder[0].attention._heads
+    eps = bert.embed_ln._epsilon
+    drop = bert.encoder[0].attention._attn_dropout
+    if drop:
+        raise MXNetError(
+            f"bert_pipeline_funcs: model was built with dropout={drop}; "
+            "pipeline stages must be deterministic — rebuild the model "
+            "with dropout=0.0 (GPipe recomputes microbatches in bubble "
+            "ticks, so stochastic stages would diverge from the DP path)")
+
+    layer_params = []
+    for layer in bert.encoder:
+        a = layer.attention
+        layer_params.append({
+            'qkv_w': _p(a.qkv.weight), 'qkv_b': _p(a.qkv.bias),
+            'proj_w': _p(a.proj.weight), 'proj_b': _p(a.proj.bias),
+            'ln1_g': _p(layer.ln1.gamma), 'ln1_b': _p(layer.ln1.beta),
+            'ffn1_w': _p(layer.ffn1.weight), 'ffn1_b': _p(layer.ffn1.bias),
+            'ffn2_w': _p(layer.ffn2.weight), 'ffn2_b': _p(layer.ffn2.bias),
+            'ln2_g': _p(layer.ln2.gamma), 'ln2_b': _p(layer.ln2.beta),
+        })
+
+    params = {
+        'embed': {
+            'word': _p(bert.word_embed.weight),
+            'pos': _p(bert.pos_embed.weight),
+            'ln_g': _p(bert.embed_ln.gamma),
+            'ln_b': _p(bert.embed_ln.beta),
+        },
+        'stages': split_layers_into_stages(layer_params, n_stages),
+        'head': {
+            'pooler_w': _p(bert.pooler.weight),
+            'pooler_b': _p(bert.pooler.bias),
+            'mlm_w': _p(model.mlm_dense.weight),
+            'mlm_b': _p(model.mlm_dense.bias),
+            'mlm_ln_g': _p(model.mlm_ln.gamma),
+            'mlm_ln_b': _p(model.mlm_ln.beta),
+            'dec_w': _p(model.mlm_decoder.weight),
+            'dec_b': _p(model.mlm_decoder.bias),
+            'nsp_w': _p(model.nsp.weight),
+            'nsp_b': _p(model.nsp.bias),
+        },
+    }
+
+    def embed_fn(p, tokens):
+        T = tokens.shape[-1]
+        emb = p['word'][tokens.astype(jnp.int32)] \
+            + p['pos'][jnp.arange(T, dtype=jnp.int32)][None, :, :]
+        return F.layer_norm(emb, p['ln_g'], p['ln_b'], eps=eps)
+
+    def one_layer(x, lp):
+        qkv = x @ lp['qkv_w'].T + lp['qkv_b']
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = attn_ops.multi_head_attention(q, k, v, num_heads=heads,
+                                             dropout_p=0.0)
+        attn = attn @ lp['proj_w'].T + lp['proj_b']
+        x = F.layer_norm(x + attn, lp['ln1_g'], lp['ln1_b'], eps=eps)
+        h = F.activation(x @ lp['ffn1_w'].T + lp['ffn1_b'],
+                         act_type='gelu')
+        h = h @ lp['ffn2_w'].T + lp['ffn2_b']
+        return F.layer_norm(x + h, lp['ln2_g'], lp['ln2_b'], eps=eps)
+
+    def stage_fn(sp, x):
+        # sp leaves: (layers_per_stage, ...) — scan over the layer axis
+        def body(carry, lp):
+            return one_layer(carry, lp), None
+        out, _ = jax.lax.scan(body, x, sp)
+        return out
+
+    def head_fn(p, seq):
+        pooled = jnp.tanh(seq[:, 0, :] @ p['pooler_w'].T + p['pooler_b'])
+        h = F.activation(seq @ p['mlm_w'].T + p['mlm_b'], act_type='gelu')
+        h = F.layer_norm(h, p['mlm_ln_g'], p['mlm_ln_b'], eps=eps)
+        mlm = h @ p['dec_w'].T + p['dec_b']
+        nsp = pooled @ p['nsp_w'].T + p['nsp_b']
+        return mlm, nsp
+
+    def loss_fn(outputs, y):
+        mlm_logits, nsp_logits = outputs
+        labels, nsp_labels = y
+        logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+        valid = (labels >= 0)
+        safe = jnp.where(valid, labels, 0)
+        tok = -jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32),
+                                   axis=-1)[..., 0] * valid
+        mlm_loss = jnp.sum(tok) / (jnp.sum(valid) + 1e-6)
+        nlogp = jax.nn.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = jnp.mean(-jnp.take_along_axis(
+            nlogp, nsp_labels[:, None].astype(jnp.int32), axis=-1))
+        return mlm_loss + nsp_loss
+
+    return params, embed_fn, stage_fn, head_fn, loss_fn

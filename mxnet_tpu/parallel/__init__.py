@@ -16,3 +16,6 @@ from . import collectives
 from .step import ShardedTrainStep
 from . import dist
 from .ring_attention import ring_attention
+from .pipeline import (pipeline_forward, pipeline_loss_fn,
+                       pipeline_composite_loss, PipelineTrainStep,
+                       stack_stage_params, split_layers_into_stages)

@@ -34,7 +34,8 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ['pipeline_forward', 'pipeline_loss_fn', 'stack_stage_params',
-           'split_layers_into_stages']
+           'split_layers_into_stages', 'pipeline_composite_loss',
+           'PipelineTrainStep']
 
 
 def stack_stage_params(stage_param_list):
@@ -136,3 +137,121 @@ def pipeline_loss_fn(stage_fn, loss_fn, mesh, pp_axis='pp'):
         return jnp.mean(jax.vmap(loss_fn)(out, y_mb))
 
     return loss
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous models (VERDICT r4 #6): real networks are not a uniform
+# layer stack — BERT is embedding → N identical encoder layers → task
+# head. The pipeline axis carries the encoder (where the FLOPs are);
+# embedding and head run replicated on every device outside the scan.
+# That is the standard TPU GPipe layout: embed/head are O(vocab·C) per
+# microbatch — negligible next to the encoder — and replicating them
+# avoids both pipeline bubbles for tiny stages and pytree-heterogeneity
+# inside the scan carry.
+# ---------------------------------------------------------------------------
+
+def pipeline_composite_loss(embed_fn, stage_fn, head_fn, loss_fn, mesh,
+                            pp_axis='pp'):
+    """loss(params, x_mb, y_mb) -> scalar for an embed→stages→head model.
+
+    params: {'embed': pytree, 'stages': stacked pytree (leading stage
+    axis, shard over pp), 'head': pytree}.
+    embed_fn(embed_params, x) -> h; stage_fn(one_stage_params, h) -> h;
+    head_fn(head_params, h) -> outputs (any pytree); loss_fn(outputs, y)
+    -> scalar. x_mb / y_mb are pytrees with a leading (M, mb) microbatch
+    axis on every leaf.
+    """
+    def loss(params, x_mb, y_mb):
+        h = jax.vmap(lambda x: embed_fn(params['embed'], x))(x_mb)
+        out = pipeline_forward(stage_fn, params['stages'], h, mesh,
+                               pp_axis=pp_axis)
+        per_mb = jax.vmap(
+            lambda o, y: loss_fn(head_fn(params['head'], o), y))(out, y_mb)
+        return jnp.mean(per_mb)
+
+    return loss
+
+
+class PipelineTrainStep:
+    """Compiled fwd+bwd+update training step over a 'pp' mesh axis — the
+    public pipeline entry point (beyond reference: SURVEY §2.5 lists no
+    pipeline schedule; the reference's model parallelism is manual
+    placement, python/mxnet/module/module.py group2ctxs).
+
+    Usage:
+        step = PipelineTrainStep(params, embed_fn, stage_fn, head_fn,
+                                 loss_fn, 'adamw', {'learning_rate': 1e-3},
+                                 mesh=mesh)
+        loss = step(x_mb, y_mb)   # microbatched pytrees; params updated
+
+    Stage parameters live sharded over pp (each device holds only its
+    stage); embed/head replicate. The whole step is ONE jit program with
+    donated param/opt-state buffers, mirroring ShardedTrainStep.
+    """
+
+    def __init__(self, params, embed_fn, stage_fn, head_fn, loss_fn,
+                 optimizer='sgd', optimizer_params=None, mesh=None,
+                 pp_axis='pp'):
+        from .step import _OPTS
+        from .mesh import default_mesh
+        if optimizer not in _OPTS:
+            raise ValueError(f"PipelineTrainStep supports {sorted(_OPTS)}")
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.pp_axis = pp_axis
+        opts = dict(optimizer_params or {})
+        self.lr = opts.pop('learning_rate', opts.pop('lr', 0.01))
+        self._opt_kwargs = opts
+        self._opt_init, self._opt_update = _OPTS[optimizer]
+        self._loss = pipeline_composite_loss(embed_fn, stage_fn, head_fn,
+                                             loss_fn, self.mesh, pp_axis)
+
+        pp_spec = P(pp_axis)
+        self._specs = {
+            'embed': jax.tree_util.tree_map(lambda _: P(), params['embed']),
+            'stages': jax.tree_util.tree_map(lambda _: pp_spec,
+                                             params['stages']),
+            'head': jax.tree_util.tree_map(lambda _: P(), params['head']),
+        }
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), self._specs,
+            is_leaf=lambda x: isinstance(x, P))
+        # copy=True: the step donates these buffers, and callers keep
+        # using the source params (often live Gluon model weights)
+        self._params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(jnp.array(p, copy=True), s),
+            params, shardings)
+        self._opt_state = jax.tree_util.tree_map(self._opt_init,
+                                                 self._params)
+
+        opt_kwargs = dict(self._opt_kwargs)
+        lr = self.lr
+
+        def step(ps, opt_state, x_mb, y_mb):
+            loss, grads = jax.value_and_grad(self._loss)(ps, x_mb, y_mb)
+            new_p = {}
+            new_s = {}
+            for group in ps:
+                flat_p, treedef = jax.tree_util.tree_flatten(ps[group])
+                flat_g = jax.tree_util.tree_leaves(grads[group])
+                flat_s = treedef.flatten_up_to(opt_state[group])
+                ups = [self._opt_update(p, g, s, lr, **opt_kwargs)
+                       for p, g, s in zip(flat_p, flat_g, flat_s)]
+                new_p[group] = jax.tree_util.tree_unflatten(
+                    treedef, [u[0] for u in ups])
+                new_s[group] = jax.tree_util.tree_unflatten(
+                    treedef, [u[1] for u in ups])
+            return loss, new_p, new_s
+
+        self._compiled = jax.jit(step, donate_argnums=(0, 1))
+
+    @property
+    def params(self):
+        return self._params
+
+    def __call__(self, x_mb, y_mb):
+        to_j = lambda a: a._data if hasattr(a, '_data') else jnp.asarray(a)
+        x_mb = jax.tree_util.tree_map(to_j, x_mb)
+        y_mb = jax.tree_util.tree_map(to_j, y_mb)
+        loss, self._params, self._opt_state = self._compiled(
+            self._params, self._opt_state, x_mb, y_mb)
+        return loss
