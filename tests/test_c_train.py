@@ -1,0 +1,273 @@
+"""C training API: a C embedder runs the full LeNet train loop
+(VERDICT r4 #4 — previously the native surface could inspect and
+predict but not train).
+
+The ABI (src/train/c_api_train.h) is pure C — driven here through
+ctypes exactly like the predict-lib tests; every call crosses the C
+boundary (handles are opaque, data moves as raw bytes). Covers: NDArray
+create/copy, imperative invoke by op name (incl. reference alias
+spellings), autograd record/mark/backward, CachedOp over a symbol JSON,
+and KVStore init/push/pull. Ref: include/mxnet/c_api.h:1251,1341,1405,
+2670.
+"""
+import ctypes
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+LIB = os.path.join(os.path.dirname(__file__), '..', 'mxnet_tpu', '_lib',
+                   'libmxtpu_train.so')
+
+u32 = ctypes.c_uint32
+H = ctypes.c_void_p
+
+
+@pytest.fixture(scope='module')
+def lib():
+    if not os.path.exists(LIB):
+        import subprocess
+        subprocess.run(['make', '-C',
+                        os.path.join(os.path.dirname(__file__), '..',
+                                     'src')],
+                       check=False, capture_output=True, timeout=300)
+    if not os.path.exists(LIB):
+        pytest.skip("native train library not built")
+    lib = ctypes.CDLL(LIB)
+    lib.MXTrainGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.MXTrainGetLastError().decode()
+
+
+def _nd_create(lib, shape, dtype=0):
+    shp = (u32 * len(shape))(*shape)
+    h = H()
+    _check(lib, lib.MXTrainNDArrayCreate(shp, len(shape), dtype,
+                                         ctypes.byref(h)))
+    return h
+
+
+def _nd_set(lib, h, arr):
+    arr = onp.ascontiguousarray(arr, onp.float32)
+    _check(lib, lib.MXTrainNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes))
+
+
+def _nd_get(lib, h, shape):
+    out = onp.empty(shape, onp.float32)
+    _check(lib, lib.MXTrainNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes))
+    return out
+
+
+def _invoke(lib, name, ins, params=None, n_out=1):
+    ins_arr = (H * len(ins))(*[i.value for i in ins])
+    outs = (H * n_out)()
+    n = u32()
+    params = params or {}
+    keys = (ctypes.c_char_p * len(params))(
+        *[k.encode() for k in params])
+    vals = (ctypes.c_char_p * len(params))(
+        *[str(v).encode() for v in params.values()])
+    _check(lib, lib.MXTrainImperativeInvoke(
+        name.encode(), len(ins), ins_arr, ctypes.byref(n), outs, n_out,
+        len(params), keys, vals))
+    return [H(outs[i]) for i in range(n.value)]
+
+
+def test_ndarray_roundtrip_and_imperative_op(lib):
+    a = _nd_create(lib, (2, 3))
+    data = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    _nd_set(lib, a, data)
+    onp.testing.assert_allclose(_nd_get(lib, a, (2, 3)), data)
+    # imperative invoke through a REFERENCE alias spelling
+    out, = _invoke(lib, '_PlusScalar', [a], {'scalar': 2.0})
+    onp.testing.assert_allclose(_nd_get(lib, out, (2, 3)), data + 2.0)
+    for h in (a, out):
+        lib.MXTrainNDArrayFree(h)
+
+
+def test_autograd_backward_through_c(lib):
+    x = _nd_create(lib, (4,))
+    g = _nd_create(lib, (4,))
+    _nd_set(lib, x, onp.asarray([1., 2., 3., 4.], onp.float32))
+    reqs = (u32 * 1)(1)
+    xs = (H * 1)(x.value)
+    gs = (H * 1)(g.value)
+    _check(lib, lib.MXTrainAutogradMarkVariables(1, xs, reqs, gs))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXTrainAutogradSetIsRecording(1, ctypes.byref(prev)))
+    y, = _invoke(lib, 'square', [x])
+    s, = _invoke(lib, 'sum', [y])
+    _check(lib, lib.MXTrainAutogradSetIsRecording(0, ctypes.byref(prev)))
+    outs = (H * 1)(s.value)
+    _check(lib, lib.MXTrainAutogradBackward(1, outs, None, 0))
+    gh = H()
+    _check(lib, lib.MXTrainNDArrayGetGrad(x, ctypes.byref(gh)))
+    onp.testing.assert_allclose(_nd_get(lib, gh, (4,)),
+                                [2., 4., 6., 8.], rtol=1e-6)
+    for h in (x, g, y, s, gh):
+        lib.MXTrainNDArrayFree(h)
+
+
+def _lenet_symbol():
+    """LeNet graph as symbol JSON (conv-pool-conv-pool-fc-fc), weights
+    as explicit inputs so the C side owns them."""
+    x = sym.Variable('data')
+    c1w = sym.Variable('c1_weight', shape=(8, 1, 5, 5))
+    c1b = sym.Variable('c1_bias', shape=(8,))
+    c1 = sym.Activation(sym.Convolution(x, c1w, c1b, kernel=(5, 5),
+                                        num_filter=8, name='c1'),
+                        act_type='relu')
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    c2w = sym.Variable('c2_weight', shape=(16, 8, 3, 3))
+    c2b = sym.Variable('c2_bias', shape=(16,))
+    c2 = sym.Activation(sym.Convolution(p1, c2w, c2b, kernel=(3, 3),
+                                        num_filter=16, name='c2'),
+                        act_type='relu')
+    p2 = sym.Pooling(c2, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    f = sym.Flatten(p2)
+    w1 = sym.Variable('fc1_weight', shape=(32, 400))
+    b1 = sym.Variable('fc1_bias', shape=(32,))
+    h1 = sym.Activation(sym.FullyConnected(f, w1, b1, num_hidden=32,
+                                           name='fc1'), act_type='relu')
+    w2 = sym.Variable('fc2_weight', shape=(10, 32))
+    b2 = sym.Variable('fc2_bias', shape=(10,))
+    out = sym.FullyConnected(h1, w2, b2, num_hidden=10, name='fc2')
+    return out
+
+
+def test_c_embedder_trains_lenet(lib):
+    """The LeNet loop end-to-end through the C ABI: CachedOp forward
+    (recorded) → softmax CE via imperative ops → backward → sgd_update
+    per parameter. Loss must drop."""
+    net = _lenet_symbol()
+    json_str = net.tojson().encode()
+    symh = H()
+    _check(lib, lib.MXTrainSymbolCreateFromJSON(json_str,
+                                                ctypes.byref(symh)))
+    n_in = u32()
+    names_pp = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXTrainSymbolListInputs(
+        symh, ctypes.byref(n_in),
+        ctypes.byref(ctypes.cast(names_pp,
+                                 ctypes.POINTER(ctypes.c_char_p)))))
+    # re-fetch properly typed
+    names_arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXTrainSymbolListInputs(symh, ctypes.byref(n_in),
+                                            ctypes.byref(names_arr)))
+    input_names = [names_arr[i].decode() for i in range(n_in.value)]
+    assert input_names[0] == 'data'
+
+    cop = H()
+    _check(lib, lib.MXTrainCreateCachedOp(symh, ctypes.byref(cop)))
+
+    shapes = {'data': (8, 1, 28, 28), 'c1_weight': (8, 1, 5, 5),
+              'c1_bias': (8,), 'c2_weight': (16, 8, 3, 3),
+              'c2_bias': (16,), 'fc1_weight': (32, 400),
+              'fc1_bias': (32,), 'fc2_weight': (10, 32),
+              'fc2_bias': (10,)}
+    rng = onp.random.RandomState(0)
+    handles = {}
+    grads = {}
+    for name in input_names:
+        shp = shapes[name]
+        handles[name] = _nd_create(lib, shp)
+        if name != 'data':
+            scale = 0.1 if 'weight' in name else 0.0
+            _nd_set(lib, handles[name],
+                    rng.randn(*shp).astype(onp.float32) * scale)
+            grads[name] = _nd_create(lib, shp)
+
+    # mark parameters for autograd
+    pnames = [n for n in input_names if n != 'data']
+    vars_arr = (H * len(pnames))(*[handles[n].value for n in pnames])
+    grads_arr = (H * len(pnames))(*[grads[n].value for n in pnames])
+    reqs = (u32 * len(pnames))(*([1] * len(pnames)))
+    _check(lib, lib.MXTrainAutogradMarkVariables(
+        len(pnames), vars_arr, reqs, grads_arr))
+
+    # learnable synthetic digits: class = blob position
+    imgs = rng.rand(8, 1, 28, 28).astype(onp.float32) * 0.1
+    labels = rng.randint(0, 10, 8).astype(onp.float32)
+    for i, l in enumerate(labels.astype(int)):
+        imgs[i, 0, l:l + 10, l:l + 10] += 0.8
+    label_h = _nd_create(lib, (8,))
+    _nd_set(lib, label_h, labels)
+
+    prev = ctypes.c_int()
+    losses = []
+    for step in range(20):
+        _nd_set(lib, handles['data'], imgs)
+        _check(lib, lib.MXTrainAutogradSetIsRecording(
+            1, ctypes.byref(prev)))
+        _check(lib, lib.MXTrainAutogradSetIsTraining(
+            1, ctypes.byref(prev)))
+        ins = (H * n_in.value)(*[handles[n].value for n in input_names])
+        outs = (H * 2)()
+        n_out = u32()
+        _check(lib, lib.MXTrainInvokeCachedOp(
+            cop, n_in.value, ins, ctypes.byref(n_out), outs, 2))
+        logits = H(outs[0])
+        loss, = _invoke(lib, 'softmax_cross_entropy',
+                        [logits, label_h])
+        _check(lib, lib.MXTrainAutogradSetIsRecording(
+            0, ctypes.byref(prev)))
+        loss_v = float(_nd_get(lib, loss, ()).reshape(-1)[0])
+        losses.append(loss_v)
+
+        heads = (H * 1)(loss.value)
+        _check(lib, lib.MXTrainAutogradBackward(1, heads, None, 0))
+
+        # sgd update every parameter through the imperative C surface
+        for nme in pnames:
+            gh = H()
+            _check(lib, lib.MXTrainNDArrayGetGrad(
+                handles[nme], ctypes.byref(gh)))
+            newp, = _invoke(lib, 'sgd_update', [handles[nme], gh],
+                            {'lr': 0.1, 'rescale_grad': 1.0 / 8})
+            # write back: copy new param into the live handle
+            shp = shapes[nme]
+            _nd_set(lib, handles[nme], _nd_get(lib, newp, shp))
+            lib.MXTrainNDArrayFree(newp)
+            lib.MXTrainNDArrayFree(gh)
+        lib.MXTrainNDArrayFree(logits)
+        lib.MXTrainNDArrayFree(loss)
+
+    assert losses[-1] < losses[0] * 0.8, losses
+    lib.MXTrainFreeCachedOp(cop)
+    lib.MXTrainSymbolFree(symh)
+    for h in handles.values():
+        lib.MXTrainNDArrayFree(h)
+    for h in grads.values():
+        lib.MXTrainNDArrayFree(h)
+    lib.MXTrainNDArrayFree(label_h)
+
+
+def test_kvstore_through_c(lib):
+    kv = H()
+    _check(lib, lib.MXTrainKVStoreCreate(b'local', ctypes.byref(kv)))
+    a = _nd_create(lib, (3,))
+    _nd_set(lib, a, onp.asarray([1., 2., 3.], onp.float32))
+    keys = (ctypes.c_int * 1)(7)
+    vals = (H * 1)(a.value)
+    _check(lib, lib.MXTrainKVStoreInit(kv, 1, keys, vals))
+    b = _nd_create(lib, (3,))
+    _nd_set(lib, b, onp.asarray([10., 10., 10.], onp.float32))
+    push_vals = (H * 1)(b.value)
+    _check(lib, lib.MXTrainKVStorePush(kv, 1, keys, push_vals, 0))
+    out = _nd_create(lib, (3,))
+    outs = (H * 1)(out.value)
+    _check(lib, lib.MXTrainKVStorePull(kv, 1, keys, outs, 0))
+    # local kvstore default updater: init value + pushed value
+    pulled = _nd_get(lib, out, (3,))
+    assert pulled.shape == (3,) and onp.isfinite(pulled).all()
+    for h in (a, b, out):
+        lib.MXTrainNDArrayFree(h)
+    lib.MXTrainKVStoreFree(kv)
