@@ -29,8 +29,11 @@ _DTYPES = {0: 'float32', 1: 'float64', 2: 'float16', 3: 'uint8',
 
 def create_ndarray(shape, dtype_code):
     from .ndarray.ndarray import zeros
-    return zeros(tuple(shape), dtype=_DTYPES.get(int(dtype_code),
-                                                 'float32'))
+    code = int(dtype_code)
+    if code not in _DTYPES:
+        raise ValueError(f"unsupported dtype code {code}; known codes: "
+                         f"{sorted(_DTYPES)}")
+    return zeros(tuple(shape), dtype=_DTYPES[code])
 
 
 def copy_from_bytes(arr, buf):
@@ -147,14 +150,10 @@ def _parse_param(v):
 
 
 def imperative_invoke(op_name, inputs, keys, vals):
-    from .base import get_op
-    from .ndarray.ndarray import _invoke, NDArray
+    from .ndarray.ndarray import imperative_invoke as _nd_invoke
     kwargs = {k: _parse_param(v) for k, v in zip(keys, vals)}
-    od = get_op(op_name)
-    out = _invoke(od.fn, *inputs, **kwargs)
-    if isinstance(out, (list, tuple)):
-        return [o if isinstance(o, NDArray) else NDArray(o) for o in out]
-    return [out if isinstance(out, NDArray) else NDArray(out)]
+    out = _nd_invoke(op_name, *inputs, **kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
 def kvstore_create(kind):

@@ -171,9 +171,11 @@ int MXTrainNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t nbytes) {
   char *src = nullptr;
   Py_ssize_t len = 0;
   PyBytes_AsStringAndSize(bytes, &src, &len);
-  if (static_cast<size_t>(len) > nbytes) {
+  if (static_cast<size_t>(len) != nbytes) {
     Py_DECREF(bytes);
-    set_error("destination buffer too small");
+    set_error("size mismatch: array holds " + std::to_string(len) +
+              " bytes, caller buffer is " + std::to_string(nbytes) +
+              " (dtype or shape disagreement)");
     return -1;
   }
   memcpy(data, src, static_cast<size_t>(len));
@@ -188,8 +190,14 @@ int MXTrainNDArrayGetShape(NDArrayHandle h, uint32_t *out_ndim,
   PyObject *shp = PyObject_CallMethod(mod, "get_shape", "O", as_py(h));
   if (!shp) { set_error_from_python(); return -1; }
   Py_ssize_t n = PyTuple_Size(shp);
+  if (n > 8) {
+    Py_DECREF(shp);
+    set_error("ndim " + std::to_string(n) +
+              " exceeds the 8-slot shape buffer contract");
+    return -1;
+  }
   *out_ndim = static_cast<uint32_t>(n);
-  for (Py_ssize_t i = 0; i < n && i < 8; ++i)
+  for (Py_ssize_t i = 0; i < n; ++i)
     out_shape[i] = static_cast<uint32_t>(
         PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i)));
   Py_DECREF(shp);
