@@ -105,3 +105,109 @@ def test_threadsafe_hybridized_inference():
     assert not errs, errs
     for got, want in zip(outs, expected):
         onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_concurrent_hybridized_forward_parity():
+    """N threads share ONE hybridized block and hammer it concurrently;
+    every result must equal the serial output (ref:
+    tests/cpp/thread_safety/thread_safety_test.cc — CachedOp used from
+    many threads). jax dispatch is thread-safe; the block's jit cache is
+    the shared mutable state under test."""
+    import threading
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu'), nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    rng = onp.random.RandomState(0)
+    xs = [rng.randn(4, 16).astype(onp.float32) for _ in range(8)]
+    expected = [net(nd.array(x)).asnumpy() for x in xs]
+
+    errors = []
+    results = [None] * len(xs)
+
+    def worker(i):
+        try:
+            for _ in range(5):
+                results[i] = net(nd.array(xs[i])).asnumpy()
+        except Exception as e:  # pragma: no cover
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for got, want in zip(results, expected):
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_concurrent_autograd_tapes_are_independent():
+    """Each thread records its own tape on its own arrays; gradients
+    must not bleed across threads (the reference keeps per-thread
+    imperative state; here state is threading.local)."""
+    import threading
+    import numpy as onp
+    from mxnet_tpu import nd, autograd
+
+    errors = []
+
+    def worker(seed):
+        try:
+            rng = onp.random.RandomState(seed)
+            x = nd.array(rng.randn(8).astype(onp.float32))
+            x.attach_grad()
+            for _ in range(3):
+                with autograd.record():
+                    y = (x * x * seed).sum()
+                y.backward()
+                onp.testing.assert_allclose(
+                    x.grad.asnumpy(), 2 * seed * x.asnumpy(), rtol=1e-5)
+        except Exception as e:  # pragma: no cover
+            errors.append((seed, e))
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(1, 7)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+
+def test_concurrent_kvstore_push_pull():
+    """Many threads pushing/pulling distinct keys on one local kvstore
+    (ref: thread-safety of KVStoreLocal)."""
+    import threading
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create('local')
+    for k in range(6):
+        kv.init(k, nd.zeros((4,)))
+    errors = []
+
+    def worker(k):
+        try:
+            for i in range(10):
+                kv.push(k, nd.ones((4,)) * (k + 1))
+                out = nd.zeros((4,))
+                kv.pull(k, out=out)
+                assert float(out.asnumpy()[0]) != 0.0
+        except Exception as e:  # pragma: no cover
+            errors.append((k, e))
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
