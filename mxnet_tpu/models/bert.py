@@ -64,12 +64,19 @@ class BertLayer(HybridBlock):
             self.ln2 = nn.LayerNorm(in_channels=hidden)
             self.dropout = nn.Dropout(dropout)
 
+    def _add_ln(self, ln, x, sub):
+        # residual + LN through one op so the fused Pallas epilogue can
+        # take it when MXTPU_PALLAS_LN=1 (ops/nn.py add_layer_norm)
+        from ..ops import nn as _nn_ops
+        return _invoke(_nn_ops.add_layer_norm, x, sub,
+                       ln.gamma.data(), ln.beta.data(), eps=ln._epsilon)
+
     def forward(self, x, mask=None):
         attn = self.attention(x, mask)
-        x = self.ln1(x + attn)
+        x = self._add_ln(self.ln1, x, attn)
         h = nd.activation(self.ffn1(x), act_type='gelu')
         h = self.dropout(self.ffn2(h))
-        return self.ln2(x + h)
+        return self._add_ln(self.ln2, x, h)
 
 
 class BertModel(HybridBlock):

@@ -306,6 +306,20 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     return out * gamma.reshape(shape) + beta.reshape(shape)
 
 
+def add_layer_norm(x, res, gamma, beta, eps=1e-5):
+    """LN(x + res) — the transformer residual epilogue, twice per BERT
+    layer. Routes to the fused Pallas kernel (ops/pallas_layernorm.py)
+    when MXTPU_PALLAS_LN=1 and a TPU is present; default is the XLA
+    path (flag-gated until measured on-chip, like the attention knobs)."""
+    import os
+    if os.environ.get('MXTPU_PALLAS_LN') == '1':
+        from .pallas_layernorm import fused_add_layer_norm, \
+            pallas_available
+        if pallas_available() and x.shape[-1] % 128 == 0:
+            return fused_add_layer_norm(x, res, gamma, beta, eps)
+    return layer_norm(x + res, gamma, beta, eps=eps)
+
+
 @_reg
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     """Ref: src/operator/nn/group_norm.cc; input NC+spatial."""

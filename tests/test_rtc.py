@@ -53,3 +53,61 @@ def test_pallas_op_requires_out_spec():
 def test_cuda_module_guidance():
     with pytest.raises(mx.MXNetError, match="Pallas"):
         mx.rtc.CudaModule("__global__ void k(){}")
+
+
+def test_fused_add_layer_norm_parity_interpret():
+    """The Pallas fused residual+LN kernel (ops/pallas_layernorm.py)
+    matches the XLA path, fwd + bwd, through the interpreter on CPU —
+    kernel code exercised for real (VERDICT r4 #1 encoder-headroom
+    candidate, flag-gated until measured on-chip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from mxnet_tpu.ops.pallas_layernorm import fused_add_layer_norm
+    from mxnet_tpu.ops import nn as F
+
+    rng = onp.random.RandomState(0)
+    B, T, C = 2, 16, 256
+    x = jnp.asarray(rng.randn(B, T, C).astype(onp.float32))
+    r = jnp.asarray(rng.randn(B, T, C).astype(onp.float32))
+    g = jnp.asarray(rng.rand(C).astype(onp.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(onp.float32))
+
+    out_p = fused_add_layer_norm(x, r, g, b, 1e-5, 8, True)
+    out_x = F.layer_norm(x + r, g, b, eps=1e-5)
+    onp.testing.assert_allclose(onp.asarray(out_p), onp.asarray(out_x),
+                                atol=2e-5)
+
+    def loss_p(x, r, g, b):
+        return jnp.sum(jnp.tanh(fused_add_layer_norm(x, r, g, b, 1e-5,
+                                                     8, True)))
+
+    def loss_x(x, r, g, b):
+        return jnp.sum(jnp.tanh(F.layer_norm(x + r, g, b, eps=1e-5)))
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3))(x, r, g, b)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2, 3))(x, r, g, b)
+    for a, e, name in zip(gp, gx, 'xrgb'):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(e),
+                                    atol=3e-5, err_msg=name)
+
+
+def test_fused_add_layer_norm_bf16():
+    import jax.numpy as jnp
+    import numpy as onp
+    from mxnet_tpu.ops.pallas_layernorm import fused_add_layer_norm
+    from mxnet_tpu.ops import nn as F
+
+    rng = onp.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 128).astype(onp.float32)).astype(
+        jnp.bfloat16)
+    r = jnp.asarray(rng.randn(4, 128).astype(onp.float32)).astype(
+        jnp.bfloat16)
+    g = jnp.ones(128, jnp.float32)
+    b = jnp.zeros(128, jnp.float32)
+    out = fused_add_layer_norm(x, r, g, b, 1e-5, 8, True)
+    assert out.dtype == jnp.bfloat16
+    ref = F.layer_norm((x + r), g, b, eps=1e-5)
+    onp.testing.assert_allclose(
+        onp.asarray(out.astype(jnp.float32)),
+        onp.asarray(ref.astype(jnp.float32)), atol=0.05)
