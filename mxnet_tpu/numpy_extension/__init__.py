@@ -174,6 +174,66 @@ class random:
     seed = staticmethod(seed)
 
 
+class image:
+    """npx.image — image ops over np ndarrays
+    (ref: numpy_extension/image.py, which re-exports the _npx__image_*
+    registry ops). Deterministic + random augmenters, all backed by the
+    registered image_* ops (HWC layout, float or uint8)."""
+
+    @staticmethod
+    def _op(name, *args, **kwargs):
+        from ..base import get_op
+        return _wrap_out(get_op(name).fn(
+            *[_unwrap(a) for a in args],
+            **{k: _unwrap(v) for k, v in kwargs.items()}))
+
+    resize = staticmethod(lambda data, size, **kw:
+                          image._op('image_resize', data, size=size, **kw))
+    crop = staticmethod(lambda data, x, y, width, height:
+                        image._op('image_crop', data, x=x, y=y,
+                                  width=width, height=height))
+    to_tensor = staticmethod(lambda data:
+                             image._op('image_to_tensor', data))
+    normalize = staticmethod(lambda data, mean=0.0, std=1.0:
+                             image._op('image_normalize', data,
+                                       mean=mean, std=std))
+    flip_left_right = staticmethod(
+        lambda data: image._op('image_flip_left_right', data))
+    flip_top_bottom = staticmethod(
+        lambda data: image._op('image_flip_top_bottom', data))
+    random_flip_left_right = staticmethod(
+        lambda data, p=0.5: image._op('_image_random_flip_left_right',
+                                      data, p=p))
+    random_flip_top_bottom = staticmethod(
+        lambda data, p=0.5: image._op('_image_random_flip_top_bottom',
+                                      data, p=p))
+    random_brightness = staticmethod(
+        lambda data, min_factor, max_factor:
+        image._op('_image_random_brightness', data,
+                  min_factor=min_factor, max_factor=max_factor))
+    random_contrast = staticmethod(
+        lambda data, min_factor, max_factor:
+        image._op('_image_random_contrast', data,
+                  min_factor=min_factor, max_factor=max_factor))
+    random_saturation = staticmethod(
+        lambda data, min_factor, max_factor:
+        image._op('_image_random_saturation', data,
+                  min_factor=min_factor, max_factor=max_factor))
+    random_hue = staticmethod(
+        lambda data, min_factor, max_factor:
+        image._op('_image_random_hue', data,
+                  min_factor=min_factor, max_factor=max_factor))
+    random_color_jitter = staticmethod(
+        lambda data, brightness=0.0, contrast=0.0, saturation=0.0,
+        hue=0.0:
+        image._op('_image_random_color_jitter', data,
+                  brightness=brightness, contrast=contrast,
+                  saturation=saturation, hue=hue))
+    random_lighting = staticmethod(
+        lambda data, alpha_std=0.05:
+        image._op('_image_random_lighting', data, alpha_std=alpha_std))
+
+
 def __getattr__(name):
     """Any registered operator is reachable as npx.<name> — the analog of
     the reference generating the npx namespace from the op registry

@@ -104,3 +104,27 @@ def test_npx_random_samplers():
     assert n.shape == (64,)
     u = npx.random.uniform_n(0.0, 1.0, batch_shape=(8,))
     assert u.shape == (8,) and 0 <= float(u.asnumpy().min())
+
+
+def test_npx_image_namespace():
+    """npx.image (ref: numpy_extension/image.py): deterministic +
+    random augmenters over np ndarrays, HWC in, registry-backed."""
+    import mxnet_tpu as mx
+    npx = mx.npx
+    img = mx.np.ones((8, 8, 3), dtype='float32') * 0.5
+    assert npx.image.to_tensor(img).shape == (3, 8, 8)
+    assert npx.image.flip_left_right(img).shape == (8, 8, 3)
+    assert npx.image.flip_top_bottom(img).shape == (8, 8, 3)
+    for name in ('random_brightness', 'random_contrast',
+                 'random_saturation', 'random_hue'):
+        assert getattr(npx.image, name)(img, 0.8, 1.2).shape == (8, 8, 3)
+    assert npx.image.random_color_jitter(
+        img, 0.2, 0.2, 0.2, 0.1).shape == (8, 8, 3)
+    assert npx.image.random_lighting(img).shape == (8, 8, 3)
+    # to_tensor follows the reference contract: uint8 [0,255] HWC in,
+    # float [0,1] CHW out
+    img_u8 = mx.np.ones((8, 8, 3), dtype='uint8') * 128
+    t = npx.image.normalize(npx.image.to_tensor(img_u8),
+                            mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    expect = (128 / 255.0 - 0.5) / 0.2
+    onp.testing.assert_allclose(onp.asarray(t._data), expect, atol=1e-5)
