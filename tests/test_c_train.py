@@ -197,6 +197,28 @@ def test_c_embedder_trains_lenet(lib):
 
     prev = ctypes.c_int()
     losses = []
+    try:
+        _run_lenet_loop(lib, handles, grads, input_names, n_in, cop,
+                        imgs, label_h, pnames, shapes, losses, prev)
+    finally:
+        # the is_training/is_recording flags are process-global
+        # (thread-local) state shared with every other test in this
+        # process — restore them no matter how the loop exits
+        lib.MXTrainAutogradSetIsTraining(0, ctypes.byref(prev))
+        lib.MXTrainAutogradSetIsRecording(0, ctypes.byref(prev))
+
+    assert losses[-1] < losses[0] * 0.8, losses
+    lib.MXTrainFreeCachedOp(cop)
+    lib.MXTrainSymbolFree(symh)
+    for h in handles.values():
+        lib.MXTrainNDArrayFree(h)
+    for h in grads.values():
+        lib.MXTrainNDArrayFree(h)
+    lib.MXTrainNDArrayFree(label_h)
+
+
+def _run_lenet_loop(lib, handles, grads, input_names, n_in, cop, imgs,
+                    label_h, pnames, shapes, losses, prev):
     for step in range(20):
         _nd_set(lib, handles['data'], imgs)
         _check(lib, lib.MXTrainAutogradSetIsRecording(
@@ -233,15 +255,6 @@ def test_c_embedder_trains_lenet(lib):
             lib.MXTrainNDArrayFree(gh)
         lib.MXTrainNDArrayFree(logits)
         lib.MXTrainNDArrayFree(loss)
-
-    assert losses[-1] < losses[0] * 0.8, losses
-    lib.MXTrainFreeCachedOp(cop)
-    lib.MXTrainSymbolFree(symh)
-    for h in handles.values():
-        lib.MXTrainNDArrayFree(h)
-    for h in grads.values():
-        lib.MXTrainNDArrayFree(h)
-    lib.MXTrainNDArrayFree(label_h)
 
 
 def test_kvstore_through_c(lib):
