@@ -405,9 +405,15 @@ def _infer_missing(symbol, known_shapes):
     missing = [n for n in names if n not in known_shapes]
     if not missing:
         return {}
-    inferred = {}
-    # deferred-style: probe with shape hints via attrs on variables
+    # forward shape propagation first (resolves auto-created params and
+    # anything downstream of the data shapes), then __shape__ hints
+    from .symbol import infer_shapes_partial
+    inferred = {n: s for n, s in
+                infer_shapes_partial(symbol, known_shapes).items()
+                if n in missing}
     for n in missing:
+        if n in inferred:
+            continue
         node = _find_var(symbol, n)
         hint = node.attrs.get('__shape__') if node is not None else None
         if hint:

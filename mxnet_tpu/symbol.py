@@ -47,24 +47,34 @@ def _iter_nodes(root, order='pre', key=id):
     return out
 
 
+def _resolve_name(op, name):
+    """One naming path for nodes AND pre-named nodes (auto-created
+    params need the node name before the node exists)."""
+    from .name import current as _nm_current
+    nm = _nm_current()
+    if nm is not None:
+        # managers see explicit names too: Prefix prepends to both
+        # (reference semantics, name.py Prefix.get)
+        return nm.get(name, op or 'var')
+    if name is None:
+        base = op if op else 'var'
+        Symbol._counter[0] += 1
+        return f"{base}{Symbol._counter[0]}"
+    return name
+
+
 class Symbol:
     _counter = [0]
 
     def __init__(self, op=None, inputs=(), attrs=None, name=None,
-                 num_outputs=1, out_index=0):
+                 num_outputs=1, out_index=0, pre_resolved=False):
         self.op = op                  # None => variable
         self.inputs = list(inputs)
         self.attrs = dict(attrs or {})
-        from .name import current as _nm_current
-        nm = _nm_current()
-        if nm is not None:
-            # managers see explicit names too: Prefix prepends to both
-            # (reference semantics, name.py Prefix.get)
-            name = nm.get(name, op or 'var')
-        elif name is None:
-            base = op if op else 'var'
-            Symbol._counter[0] += 1
-            name = f"{base}{Symbol._counter[0]}"
+        # pre_resolved: _apply already ran the name through the manager
+        # (auto-created params need the node name before the node) —
+        # resolving twice would double-apply a Prefix manager
+        name = name if pre_resolved else _resolve_name(op, name)
         self._name = name
         self.num_outputs = num_outputs
         self.out_index = out_index
@@ -200,10 +210,20 @@ class Symbol:
                     grp = node.attrs.get('__ctx_group__')
                     if grp in group2ctx:
                         arg_ctx[node._name] = group2ctx[grp]
+        missing = [n for n in names if n not in shapes]
+        if missing:
+            # auto-created params + anything reachable by forward shape
+            # propagation resolve here (ref: simple_bind's InferShape)
+            inferred = infer_shapes_partial(self, shapes)
+            for n in missing:
+                if n in inferred:
+                    shapes[n] = inferred[n]
         args = {}
         for n in names:
             if n not in shapes:
-                raise MXNetError(f"simple_bind missing shape for {n}")
+                raise MXNetError(
+                    f"simple_bind missing shape for {n} (not inferable "
+                    f"from the given shapes)")
             args[n] = nd_zeros(shapes[n], arg_ctx[n])
         grads = {n: nd_zeros(shapes[n], arg_ctx[n]) for n in names} \
             if grad_req != 'null' else {}
@@ -319,11 +339,150 @@ def _op_arity(opname, attrs):
     return 1
 
 
+# ---------------------------------------------------------------------------
+# Auto-created parameters (ref: nnvm registers hidden weight/bias inputs
+# per layer op; symbol users write sym.FullyConnected(x, num_hidden=N)
+# and fcN_weight / fcN_bias appear as graph inputs, shapes inferred at
+# bind). Table: op -> [(suffix, shape_rule(data_shape, attrs), skip_if)].
+# ---------------------------------------------------------------------------
+
+def _truthy(v):
+    return v in (True, 1, '1', 'true', 'True')
+
+
+def _prod(t):
+    out = 1
+    for s in t:
+        out *= int(s)
+    return out
+
+
+def _t2(v):
+    return (int(v), int(v)) if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+_AUTO_PARAMS = {
+    'fully_connected': [
+        ('weight', lambda d, a: (int(a['num_hidden']),
+                                 _prod(d[1:])
+                                 if _truthy(a.get('flatten', True))
+                                 else int(d[-1])), None),
+        ('bias', lambda d, a: (int(a['num_hidden']),),
+         lambda a: _truthy(a.get('no_bias', False))),
+    ],
+    'convolution': [
+        ('weight', lambda d, a: (int(a['num_filter']), int(d[1]))
+         + _t2(a['kernel']), None),
+        ('bias', lambda d, a: (int(a['num_filter']),),
+         lambda a: _truthy(a.get('no_bias', False))),
+    ],
+    'deconvolution': [
+        # mxnet layout: (in_channels, num_filter, kh, kw)
+        ('weight', lambda d, a: (int(d[1]), int(a['num_filter']))
+         + _t2(a['kernel']), None),
+        ('bias', lambda d, a: (int(a['num_filter']),),
+         lambda a: _truthy(a.get('no_bias', True))),
+    ],
+    'batch_norm': [
+        ('gamma', lambda d, a: (int(d[1]),), None),
+        ('beta', lambda d, a: (int(d[1]),), None),
+        ('moving_mean', lambda d, a: (int(d[1]),), None),
+        ('moving_var', lambda d, a: (int(d[1]),), None),
+    ],
+    'layer_norm': [
+        ('gamma', lambda d, a: (int(d[int(a.get('axis', -1))]),), None),
+        ('beta', lambda d, a: (int(d[int(a.get('axis', -1))]),), None),
+    ],
+    'instance_norm': [
+        ('gamma', lambda d, a: (int(d[1]),), None),
+        ('beta', lambda d, a: (int(d[1]),), None),
+    ],
+    'embedding': [
+        ('weight', lambda d, a: (int(a['input_dim']),
+                                 int(a['output_dim'])), None),
+    ],
+}
+
+
+def infer_shapes_partial(root, known):
+    """Forward shape propagation over the DAG: {var name: shape} for
+    every variable resolvable from `known` (typically just the data
+    shapes) — auto-created params resolve through their shape rules,
+    op outputs through jax.eval_shape (abstract evaluation IS the
+    shape-inference pass; ref: nnvm InferShape)."""
+    import jax
+
+    shape_of = {}    # uid -> tuple (single) | list[tuple] (multi-output)
+
+    def shape_for(node):
+        raw = shape_of.get(node._uid)
+        if raw is None:
+            return None
+        return raw[node.out_index] if isinstance(raw, list) else raw
+
+    result = {}
+    for node in _iter_nodes(root, 'post', key=lambda n: n._uid):
+        if node.op is None:
+            shp = known.get(node._name) or node.attrs.get('__shape__')
+            if shp is not None:
+                shape_of[node._uid] = tuple(shp)
+                result[node._name] = tuple(shp)
+            continue
+        dshape = shape_for(node.inputs[0]) if node.inputs else None
+        for v in node.inputs[1:]:
+            if v.op is None and v._uid not in shape_of \
+                    and getattr(v, '_shape_rule', None) is not None \
+                    and dshape is not None:
+                try:
+                    shp = tuple(v._shape_rule(dshape, node.attrs))
+                except (KeyError, TypeError, ValueError, IndexError):
+                    continue
+                shape_of[v._uid] = shp
+                result[v._name] = shp
+        in_shapes = [shape_for(i) for i in node.inputs]
+        if any(s is None for s in in_shapes):
+            continue
+        opdef = get_op(node.op)
+        clean = {k: v for k, v in node.attrs.items()
+                 if not k.startswith('__')}
+        out = None
+        for probe_dtype in (jnp.float32, jnp.int32):
+            try:
+                out = jax.eval_shape(
+                    lambda *xs: opdef.fn(*xs, **clean),
+                    *[jax.ShapeDtypeStruct(s_, probe_dtype)
+                      for s_ in in_shapes])
+                break
+            except Exception:
+                continue
+        if out is None:
+            continue
+        if isinstance(out, (list, tuple)):
+            shape_of[node._uid] = [tuple(o.shape) for o in out]
+        else:
+            shape_of[node._uid] = tuple(out.shape)
+    return result
+
+
 def _apply(opname, inputs, attrs, name=None):
     from .attribute import current_attrs
     attrs = current_attrs(attrs)
+    specs = _AUTO_PARAMS.get(opname)
+    resolved = None
+    if specs is not None and len(inputs) == 1:
+        # only the data input given: synthesize {node}_{suffix} param
+        # variables carrying their shape rules for bind-time inference
+        resolved = _resolve_name(opname, name)
+        for suffix, rule, skip in specs:
+            if skip is not None and skip(attrs):
+                continue
+            v = Symbol(None, (), None, f"{resolved}_{suffix}",
+                       pre_resolved=True)
+            v._shape_rule = rule
+            inputs = list(inputs) + [v]
     n = _op_arity(opname, attrs)
-    s = Symbol(opname, inputs, attrs, name, num_outputs=n)
+    s = Symbol(opname, inputs, attrs, resolved or name, num_outputs=n,
+               pre_resolved=resolved is not None)
     if n == 1:
         return s
     return tuple(s[i] for i in range(n))

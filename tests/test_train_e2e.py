@@ -300,3 +300,31 @@ def test_tiny_transformer_overfits_10x():
         if last < first / 10:
             break
     assert last < first / 10, (first, last)
+
+
+def test_module_fit_with_auto_created_params():
+    """The reference idiom: sym.FullyConnected(x, num_hidden=N) with NO
+    explicit weight/bias variables — fcN_weight/fcN_bias auto-create and
+    their shapes infer at bind (round 5: symbol.py _AUTO_PARAMS +
+    infer_shapes_partial). Must train to >=97% through Module.fit."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.test_utils import get_mnist_iterator
+
+    mx.random.seed(2)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64,
+                                              input_shape=(784,))
+    x = sym.Variable('data')
+    h1 = sym.Activation(sym.FullyConnected(x, num_hidden=64, name='fc1'),
+                        act_type='relu')
+    out = sym.SoftmaxOutput(sym.FullyConnected(h1, num_hidden=10,
+                                               name='fc2'),
+                            sym.Variable('softmax_label'), name='softmax')
+    assert 'fc1_weight' in out.list_arguments()
+    mod = Module(out, data_names=('data',), label_names=('softmax_label',),
+                 context=mx.cpu(0))
+    mod.fit(train_iter, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.05, 'momentum': 0.9},
+            initializer=mx.init.Xavier(), num_epoch=10)
+    score = dict(mod.score(val_iter, 'acc'))
+    assert score['accuracy'] >= 0.97, score
