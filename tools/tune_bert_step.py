@@ -22,6 +22,10 @@ def main():
     ap.add_argument('--steps', type=int, default=10)
     ap.add_argument('--rbg', action='store_true',
                     help='use the rbg PRNG (cheap random bits on TPU)')
+    ap.add_argument('--trace', metavar='DIR', default=None,
+                    help='capture an xprof trace of the timed steps into '
+                         'DIR (view with tensorboard --logdir DIR); the '
+                         'op-level breakdown PERF_NOTES.md waits on')
     args = ap.parse_args()
 
     import jax
@@ -71,11 +75,17 @@ def main():
     print(f"compile+first: {time.time() - t0:.1f}s loss={v:.4f}", flush=True)
     for _ in range(2):
         step(inputs, [labels, nsp])
-    t0 = time.time()
-    for _ in range(args.steps):
-        loss = step(inputs, [labels, nsp])
-    float(loss.asnumpy())
-    dt = (time.time() - t0) / args.steps
+    import contextlib
+    tracer = jax.profiler.trace(args.trace) if args.trace \
+        else contextlib.nullcontext()
+    with tracer:
+        t0 = time.time()
+        for _ in range(args.steps):
+            loss = step(inputs, [labels, nsp])
+        float(loss.asnumpy())
+        dt = (time.time() - t0) / args.steps
+    if args.trace:
+        print(f"xprof trace written to {args.trace}", flush=True)
 
     params = model.collect_params()
     P = sum(int(onp.prod(p.shape)) for p in params.values())
