@@ -1,0 +1,175 @@
+/*
+ * Standalone C embedder: trains an MLP end-to-end through the
+ * libmxtpu_train.so C ABI (src/train/c_api_train.h) with NO Python
+ * code in this file — CPython is embedded by the library itself.
+ *
+ * Build + run (see Makefile):
+ *     make -C examples/c_embedder run
+ *
+ * The loop: create NDArrays -> mark parameters -> CachedOp forward
+ * under recording -> softmax cross-entropy via imperative invoke ->
+ * backward -> per-parameter sgd_update. Prints the loss trajectory.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../src/train/c_api_train.h"
+
+#define CHECK(rc)                                                     \
+  do {                                                                \
+    if ((rc) != 0) {                                                  \
+      fprintf(stderr, "error at %s:%d: %s\n", __FILE__, __LINE__,     \
+              MXTrainGetLastError());                                 \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+/* tiny xorshift for reproducible synthetic data */
+static unsigned int rng_state = 42;
+static float frand(void) {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 17;
+  rng_state ^= rng_state << 5;
+  return (float)(rng_state % 10000) / 10000.0f - 0.5f;
+}
+
+static NDArrayHandle nd_new(const uint32_t *shape, uint32_t ndim) {
+  NDArrayHandle h;
+  CHECK(MXTrainNDArrayCreate(shape, ndim, 0 /*f32*/, &h));
+  return h;
+}
+
+static void nd_fill(NDArrayHandle h, const float *data, size_t n) {
+  CHECK(MXTrainNDArraySyncCopyFromCPU(h, data, n * sizeof(float)));
+}
+
+static void nd_read(NDArrayHandle h, float *out, size_t n) {
+  CHECK(MXTrainNDArraySyncCopyToCPU(h, out, n * sizeof(float)));
+}
+
+static NDArrayHandle invoke1(const char *op, NDArrayHandle *ins,
+                             uint32_t nin, const char **keys,
+                             const char **vals, uint32_t nparams) {
+  NDArrayHandle outs[4];
+  uint32_t nout = 0;
+  CHECK(MXTrainImperativeInvoke(op, nin, ins, &nout, outs, 4, nparams,
+                                keys, vals));
+  return outs[0];
+}
+
+int main(void) {
+  enum { B = 32, D = 16, H = 24, C = 3, STEPS = 40 };
+
+  /* ---- parameters + grads ---- */
+  const uint32_t w1s[] = {H, D}, b1s[] = {H}, w2s[] = {C, H},
+                 b2s[] = {C};
+  NDArrayHandle w1 = nd_new(w1s, 2), b1 = nd_new(b1s, 1);
+  NDArrayHandle w2 = nd_new(w2s, 2), b2 = nd_new(b2s, 1);
+  NDArrayHandle g1 = nd_new(w1s, 2), gb1 = nd_new(b1s, 1);
+  NDArrayHandle g2 = nd_new(w2s, 2), gb2 = nd_new(b2s, 1);
+
+  float tmp[H * D];
+  for (int i = 0; i < H * D; ++i) tmp[i] = frand() * 0.6f;
+  nd_fill(w1, tmp, H * D);
+  for (int i = 0; i < C * H; ++i) tmp[i] = frand() * 0.6f;
+  nd_fill(w2, tmp, C * H);
+  memset(tmp, 0, sizeof(tmp));
+  nd_fill(b1, tmp, H);
+  nd_fill(b2, tmp, C);
+
+  NDArrayHandle params[] = {w1, b1, w2, b2};
+  NDArrayHandle grads[] = {g1, gb1, g2, gb2};
+  const uint32_t reqs[] = {1, 1, 1, 1};
+  CHECK(MXTrainAutogradMarkVariables(4, params, reqs, grads));
+
+  /* ---- synthetic 3-class problem: argmax of a fixed projection ---- */
+  static float x[B * D], labels[B];
+  float proj[D * C];
+  for (int i = 0; i < D * C; ++i) proj[i] = frand();
+  for (int b = 0; b < B; ++b) {
+    float score[C] = {0};
+    for (int d = 0; d < D; ++d) {
+      x[b * D + d] = frand();
+      for (int c = 0; c < C; ++c)
+        score[c] += x[b * D + d] * proj[d * C + c];
+    }
+    int best = 0;
+    for (int c = 1; c < C; ++c)
+      if (score[c] > score[best]) best = c;
+    labels[b] = (float)best;
+  }
+  const uint32_t xs[] = {B, D}, ls[] = {B};
+  NDArrayHandle xh = nd_new(xs, 2), lh = nd_new(ls, 1);
+  nd_fill(xh, x, B * D);
+  nd_fill(lh, labels, B);
+
+  /* ---- training loop ---- */
+  const char *nh_keys[] = {"num_hidden"};
+  const char *nh_h[] = {"24"};
+  const char *nh_c[] = {"3"};
+  const char *act_keys[] = {"act_type"};
+  const char *act_vals[] = {"relu"};
+  const char *sgd_keys[] = {"lr", "rescale_grad"};
+  const char *sgd_vals[] = {"0.4", "0.03125"};
+
+  int prev;
+  float first = 0, last = 0;
+  for (int step = 0; step < STEPS; ++step) {
+    CHECK(MXTrainAutogradSetIsRecording(1, &prev));
+    CHECK(MXTrainAutogradSetIsTraining(1, &prev));
+
+    NDArrayHandle fc1_in[] = {xh, w1, b1};
+    NDArrayHandle h1 = invoke1("fully_connected", fc1_in, 3, nh_keys,
+                               nh_h, 1);
+    NDArrayHandle a1 = invoke1("activation", &h1, 1, act_keys, act_vals,
+                               1);
+    NDArrayHandle fc2_in[] = {a1, w2, b2};
+    NDArrayHandle logits = invoke1("fully_connected", fc2_in, 3, nh_keys,
+                                   nh_c, 1);
+    NDArrayHandle ce_in[] = {logits, lh};
+    NDArrayHandle loss = invoke1("softmax_cross_entropy", ce_in, 2, NULL,
+                                 NULL, 0);
+
+    CHECK(MXTrainAutogradSetIsRecording(0, &prev));
+    CHECK(MXTrainAutogradBackward(1, &loss, NULL, 0));
+
+    float lv;
+    nd_read(loss, &lv, 1);
+    if (step == 0) first = lv;
+    last = lv;
+    if (step % 10 == 0) printf("step %2d  loss %.4f\n", step, lv);
+
+    for (int p = 0; p < 4; ++p) {
+      NDArrayHandle gh;
+      CHECK(MXTrainNDArrayGetGrad(params[p], &gh));
+      NDArrayHandle upd_in[] = {params[p], gh};
+      NDArrayHandle newp = invoke1("sgd_update", upd_in, 2, sgd_keys,
+                                   sgd_vals, 2);
+      /* copy the updated values back into the live (marked) handle */
+      uint32_t nd_, shp[8];
+      CHECK(MXTrainNDArrayGetShape(params[p], &nd_, shp));
+      size_t n = 1;
+      for (uint32_t i = 0; i < nd_; ++i) n *= shp[i];
+      float *buf = (float *)malloc(n * sizeof(float));
+      nd_read(newp, buf, n);
+      nd_fill(params[p], buf, n);
+      free(buf);
+      MXTrainNDArrayFree(newp);
+      MXTrainNDArrayFree(gh);
+    }
+    MXTrainNDArrayFree(h1);
+    MXTrainNDArrayFree(a1);
+    MXTrainNDArrayFree(logits);
+    MXTrainNDArrayFree(loss);
+  }
+  CHECK(MXTrainAutogradSetIsTraining(0, &prev));
+
+  printf("loss %.4f -> %.4f\n", first, last);
+  if (!(last < first * 0.5f)) {
+    fprintf(stderr, "FAIL: loss did not halve\n");
+    return 1;
+  }
+  printf("C EMBEDDER TRAIN OK\n");
+  return 0;
+}
