@@ -282,7 +282,19 @@ class Module(BaseModule):
             self._arg_params[name] = arr
             for e in self._execs[1:]:
                 e.arg_dict[name]._data = arr._data
+        # aux states (BN moving stats): initializer routes by suffix
+        # (moving_mean -> zeros, moving_var -> ones), shared across execs
         self._aux_params = {}
+        for name, arr in self._execs[0].aux_dict.items():
+            if aux_params and name in aux_params:
+                arr._data = aux_params[name]._data
+            else:
+                host = nd_zeros(arr.shape)
+                initializer(init_mod.InitDesc(name), host)
+                arr._data = host._data
+            self._aux_params[name] = arr
+            for e in self._execs[1:]:
+                e.aux_dict[name]._data = arr._data
         self.params_initialized = True
 
     def get_params(self):

@@ -82,12 +82,15 @@ def fused_add_layer_norm(x, res, gamma, beta, eps=1e-5, block_rows=256,
 
 def _fwd(x, res, gamma, beta, eps, block_rows, interpret):
     out = _fwd_impl(x, res, gamma, beta, eps, block_rows, interpret)
-    return out, (x, res, gamma)
+    # save the SUM: the backward only ever uses x+res (dx == dres), and
+    # saving x and res separately would double the residual footprint
+    # on exactly the bandwidth-constrained path this kernel relieves
+    return out, (x + res, gamma)
 
 
 def _bwd(eps, block_rows, interpret, saved, g):
-    x, res, gamma = saved
-    s = (x + res).astype(jnp.float32)
+    s_in, gamma = saved
+    s = s_in.astype(jnp.float32)
     mean = jnp.mean(s, axis=-1, keepdims=True)
     xc = s - mean
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)
@@ -96,11 +99,10 @@ def _bwd(eps, block_rows, interpret, saved, g):
     gf = g.astype(jnp.float32)
     dgamma = jnp.sum(gf * xhat, axis=tuple(range(g.ndim - 1)))
     dbeta = jnp.sum(gf, axis=tuple(range(g.ndim - 1)))
-    C = x.shape[-1]
     gg = gf * gamma.astype(jnp.float32)
     dx = inv * (gg - jnp.mean(gg, axis=-1, keepdims=True)
                 - xhat * jnp.mean(gg * xhat, axis=-1, keepdims=True))
-    dx = dx.astype(x.dtype)
+    dx = dx.astype(s_in.dtype)
     return dx, dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
 
 

@@ -91,7 +91,8 @@ class Symbol:
     def list_arguments(self):
         seen = []
         for s in _iter_nodes(self, 'pre'):
-            if s.op is None and s._name not in seen:
+            if s.op is None and s._name not in seen \
+                    and not s.attrs.get('__aux__'):
                 seen.append(s._name)
         return seen
 
@@ -99,7 +100,19 @@ class Symbol:
         return [self._name + '_output']
 
     def list_auxiliary_states(self):
-        return []
+        """Variables carrying the __aux__ marker (auto-created BN moving
+        stats): allocated and initialized by executors, excluded from
+        gradients and optimizer updates (ref: nnvm mutable inputs).
+        NOTE symbol-path limitation (documented): training-mode BN
+        normalizes with batch statistics but does not write running
+        averages back into the aux arrays — the gluon path owns running
+        stats; set_params/aux_dict load them for inference here."""
+        seen = []
+        for s in _iter_nodes(self, 'pre'):
+            if s.op is None and s.attrs.get('__aux__') \
+                    and s._name not in seen:
+                seen.append(s._name)
+        return seen
 
     def get_internals(self):
         return _SymbolList(_iter_nodes(self, 'post'))
@@ -210,7 +223,8 @@ class Symbol:
                     grp = node.attrs.get('__ctx_group__')
                     if grp in group2ctx:
                         arg_ctx[node._name] = group2ctx[grp]
-        missing = [n for n in names if n not in shapes]
+        aux_names = self.list_auxiliary_states()
+        missing = [n for n in names + aux_names if n not in shapes]
         if missing:
             # auto-created params + anything reachable by forward shape
             # propagation resolve here (ref: simple_bind's InferShape)
@@ -225,10 +239,18 @@ class Symbol:
                     f"simple_bind missing shape for {n} (not inferable "
                     f"from the given shapes)")
             args[n] = nd_zeros(shapes[n], arg_ctx[n])
+        aux = {}
+        for n in aux_names:
+            if n not in shapes:
+                raise MXNetError(
+                    f"simple_bind missing shape for aux state {n}")
+            aux[n] = nd_zeros(shapes[n], arg_ctx.get(n, ctx))
+            if n.endswith(('moving_var', 'running_var')):
+                aux[n][:] = 1.0   # variance aux starts at one
         grads = {n: nd_zeros(shapes[n], arg_ctx[n]) for n in names} \
             if grad_req != 'null' else {}
         return Executor(self, args, grads, grad_req, ctx,
-                        group2ctx=group2ctx)
+                        group2ctx=group2ctx, aux_states=aux)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req='write',
              aux_states=None, **kwargs):
@@ -383,11 +405,13 @@ _AUTO_PARAMS = {
         ('bias', lambda d, a: (int(a['num_filter']),),
          lambda a: _truthy(a.get('no_bias', True))),
     ],
+    # suffixes starting '!' mark AUXILIARY states (no grad, no optimizer
+    # update — the reference's mutable inputs)
     'batch_norm': [
         ('gamma', lambda d, a: (int(d[1]),), None),
         ('beta', lambda d, a: (int(d[1]),), None),
-        ('moving_mean', lambda d, a: (int(d[1]),), None),
-        ('moving_var', lambda d, a: (int(d[1]),), None),
+        ('!moving_mean', lambda d, a: (int(d[1]),), None),
+        ('!moving_var', lambda d, a: (int(d[1]),), None),
     ],
     'layer_norm': [
         ('gamma', lambda d, a: (int(d[int(a.get('axis', -1))]),), None),
@@ -430,15 +454,26 @@ def infer_shapes_partial(root, known):
             continue
         dshape = shape_for(node.inputs[0]) if node.inputs else None
         for v in node.inputs[1:]:
-            if v.op is None and v._uid not in shape_of \
-                    and getattr(v, '_shape_rule', None) is not None \
-                    and dshape is not None:
-                try:
-                    shp = tuple(v._shape_rule(dshape, node.attrs))
-                except (KeyError, TypeError, ValueError, IndexError):
-                    continue
-                shape_of[v._uid] = shp
-                result[v._name] = shp
+            if v.op is not None or v._uid in shape_of or dshape is None:
+                continue
+            rule = getattr(v, '_shape_rule', None)
+            if rule is None:
+                # round-tripped graph: the live rule is gone but the
+                # serialized marker names it
+                suffix = v.attrs.get('__auto_param__')
+                if suffix is not None:
+                    for sfx, r, _skip in _AUTO_PARAMS.get(node.op, ()):
+                        if sfx == suffix:
+                            rule = r
+                            break
+            if rule is None:
+                continue
+            try:
+                shp = tuple(rule(dshape, node.attrs))
+            except (KeyError, TypeError, ValueError, IndexError):
+                continue
+            shape_of[v._uid] = shp
+            result[v._name] = shp
         in_shapes = [shape_for(i) for i in node.inputs]
         if any(s is None for s in in_shapes):
             continue
@@ -476,9 +511,18 @@ def _apply(opname, inputs, attrs, name=None):
         for suffix, rule, skip in specs:
             if skip is not None and skip(attrs):
                 continue
-            v = Symbol(None, (), None, f"{resolved}_{suffix}",
+            aux = suffix.startswith('!')
+            clean_suffix = suffix[1:] if aux else suffix
+            v = Symbol(None, (), None, f"{resolved}_{clean_suffix}",
                        pre_resolved=True)
             v._shape_rule = rule
+            # the declarative markers SERIALIZE (attrs survive
+            # tojson/fromjson), so a round-tripped graph re-binds its
+            # auto-params: infer_shapes_partial falls back to looking
+            # the rule up by (consumer op, suffix)
+            v.attrs['__auto_param__'] = suffix
+            if aux:
+                v.attrs['__aux__'] = True
             inputs = list(inputs) + [v]
     n = _op_arity(opname, attrs)
     s = Symbol(opname, inputs, attrs, resolved or name, num_outputs=n,
@@ -532,7 +576,9 @@ def fromjson(js):
             except Exception:
                 attrs[k] = v
         if node['op'] == 'null':
-            built.append(var(node['name']))
+            v = var(node['name'])
+            v.attrs.update(attrs)   # __shape__/__auto_param__ markers
+            built.append(v)
         else:
             n = _op_arity(node['op'], attrs)
             built.append(Symbol(node['op'], inputs, attrs, node['name'],
@@ -548,10 +594,13 @@ class Executor:
     executor.py). forward/backward each run one jitted XLA call."""
 
     def __init__(self, symbol, args, args_grad, grad_req, ctx,
-                 group2ctx=None):
+                 group2ctx=None, aux_states=None):
         self._symbol = symbol
         self.arg_dict = args
         self.grad_dict = args_grad
+        # aux states (BN moving stats): bound into the graph like args
+        # but carry no gradient and no optimizer update
+        self.aux_dict = dict(aux_states or {})
         self._grad_req = grad_req
         self._ctx = ctx
         self._names = symbol.list_arguments()
@@ -597,10 +646,6 @@ class Executor:
         self._monitor = None if callback is None else \
             _AlwaysOn(callback, monitor_all)
 
-    @property
-    def aux_dict(self):
-        return {}
-
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if isinstance(v, NDArray):
@@ -608,6 +653,8 @@ class Executor:
             else:
                 self.arg_dict[k]._data = jnp.asarray(v)
         bind = {n: self.arg_dict[n]._data for n in self._names}
+        for n, a in self.aux_dict.items():
+            bind[n] = a._data
         mon = getattr(self, '_monitor', None)
         if mon is not None and mon.activated:
             # monitored forward: eager per-node evaluation feeding the

@@ -269,3 +269,64 @@ def test_softmax_output_jit_inference():
         onp.asarray(out), onp.asarray(jax.nn.softmax(d, -1)), rtol=1e-6)
     g = jax.grad(lambda d: jnp.sum(f(d, lab)))(d)
     assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_symbol_auto_params_json_roundtrip_binds():
+    """Auto-created params carry a SERIALIZED __auto_param__ marker, so
+    a tojson/fromjson round-trip still shape-infers and binds (review
+    r5: the live _shape_rule closure is not the source of truth)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, symbol as S
+
+    out = sym.FullyConnected(sym.Variable('data'), num_hidden=8,
+                             name='fc1')
+    rt = S.fromjson(out.tojson())
+    ex = rt.simple_bind(mx.cpu(), data=(4, 16))
+    assert ex.arg_dict['fc1_weight'].shape == (8, 16)
+    assert ex.arg_dict['fc1_bias'].shape == (8,)
+
+
+def test_batchnorm_auto_params_are_aux_states():
+    """Auto-created BN moving stats classify as AUXILIARY states:
+    excluded from arguments/gradients/optimizer updates, allocated with
+    mean=0 / var=1, surfaced through Module.get_params()[1] — wd must
+    never decay a running variance (review r5)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, symbol as S
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    x = sym.Variable('data')
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=4, name='c1')
+    bn = sym.BatchNorm(c, name='bn1')
+    bn0 = bn[0] if isinstance(bn, tuple) else bn
+    f = sym.FullyConnected(sym.Flatten(sym.Activation(bn0,
+                                                      act_type='relu')),
+                           num_hidden=2, name='fc')
+    out = sym.SoftmaxOutput(f, sym.Variable('softmax_label'), name='sm')
+
+    aux = out.list_auxiliary_states()
+    assert set(aux) == {'bn1_moving_mean', 'bn1_moving_var'}
+    assert not set(aux) & set(out.list_arguments())
+    # serialization keeps the classification
+    assert set(S.fromjson(out.tojson()).list_auxiliary_states()) == set(aux)
+
+    ex = out.simple_bind(mx.cpu(), data=(2, 3, 8, 8), softmax_label=(2,))
+    onp.testing.assert_allclose(ex.aux_dict['bn1_moving_var'].asnumpy(),
+                                1.0)
+    ex.forward(is_train=True)
+    ex.backward()
+    assert 'bn1_moving_mean' not in ex.grad_dict
+
+    X = onp.random.RandomState(0).rand(32, 3, 8, 8).astype('f')
+    Y = (X.mean(axis=(1, 2, 3)) > 0.5).astype('f')
+    mod = Module(out, data_names=('data',),
+                 label_names=('softmax_label',), context=mx.cpu(0))
+    it = NDArrayIter(X, Y, batch_size=8, label_name='softmax_label')
+    mod.fit(it, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1, 'wd': 0.01},
+            initializer=mx.init.Xavier(), num_epoch=2)
+    _, auxp = mod.get_params()
+    # untouched by the optimizer (wd would have decayed a trainable arg)
+    onp.testing.assert_allclose(auxp['bn1_moving_var'].asnumpy(), 1.0)
