@@ -33,6 +33,7 @@ from . import io
 from . import recordio
 from . import gluon
 from . import profiler
+from . import telemetry
 from . import callback
 from . import runtime
 from . import config

@@ -14,7 +14,8 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
-from .base import state, MXNetError, prof_flags, record_op_use
+from .base import (state, MXNetError, prof_flags, record_op_use,
+                   telem_flags as _telem)
 
 
 class TapeNode:
@@ -74,6 +75,10 @@ def invoke(fn: Callable, args: tuple, kwargs: dict):
 
     datas = tuple(t._data for t in tensor_inputs)
     recording = state.is_recording and any(t._in_graph for t in tensor_inputs)
+
+    if _telem['on']:
+        from . import telemetry as _telemetry
+        _telemetry.inc('mxnet_tpu_imperative_ops_total')
 
     try:
         if prof_flags['op']:

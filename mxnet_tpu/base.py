@@ -211,3 +211,9 @@ state = _ThreadLocalState()
 # autograd flags above which are deliberately thread-local). Written by
 # profiler._sync_flags(), read by _imperative.invoke.
 prof_flags = {'op': False, 'sync': False}
+
+# PROCESS-wide telemetry gate, same pattern: written by
+# telemetry.enable()/disable(), read inline by every instrumented hot
+# path (imperative dispatch, compile caches, kvstore, IO, trainer step)
+# so a disabled run pays one dict lookup per site and records nothing.
+telem_flags = {'on': False}

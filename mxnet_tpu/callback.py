@@ -4,6 +4,8 @@ from __future__ import annotations
 import logging
 import time
 
+from .base import telem_flags as _telem
+
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     period = int(max(1, period))
@@ -54,10 +56,23 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float('inf')
+                speed = None
+                if _telem['on']:
+                    # the trainer's step gauge is the sharper number when
+                    # a Trainer is driving (true inter-step rate, not the
+                    # callback's coarser window) — but only when fresh:
+                    # a gauge left over from an earlier training phase
+                    # must not override an eval loop's own measurement
+                    from . import telemetry as _telemetry
+                    speed = _telemetry.recent_samples_per_second(
+                        max(time.time() - self.tic, 1e-3))
+                    _telemetry.inc('mxnet_tpu_speedometer_logs_total')
+                if speed is None:
+                    try:
+                        speed = self.frequent * self.batch_size / \
+                            (time.time() - self.tic)
+                    except ZeroDivisionError:
+                        speed = float('inf')
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

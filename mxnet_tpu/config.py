@@ -157,3 +157,13 @@ register('MXNET_SUBGRAPH_BACKEND', str, '',
          'call does not name one (see mxnet_tpu.subgraph).')
 register('MXNET_SEED', int, 0,
          'Process-wide RNG seed applied at import when set.')
+register('MXNET_TPU_TELEMETRY', _bool, False,
+         'Enable the runtime telemetry registry (mxnet_tpu.telemetry): '
+         'op-dispatch/compile/kvstore/IO/step metrics with Prometheus, '
+         'JSON and chrome-trace export. Off: instrumented paths take a '
+         'single flag-check fast path.')
+register('MXNET_TPU_RECOMPILE_WARN_THRESHOLD', int, 3,
+         'Telemetry recompile detector: warn (once per compile site) '
+         'when one site, e.g. a hybridized block, compiles more than '
+         'this many times — churning input shapes/dtypes force an XLA '
+         'recompile every step.')

@@ -2,7 +2,11 @@
 Symbol executor and adds the monitor-callback surface. The executor
 itself lives in symbol.py (the DAG and its compiled evaluation are one
 design unit here); this module keeps the reference's import path
-`mx.executor.Executor` working."""
+`mx.executor.Executor` working.
+
+With telemetry enabled (MXNET_TPU_TELEMETRY=1), every Executor.forward
+reports into mxnet_tpu_executor_forward_total /
+mxnet_tpu_executor_forward_seconds — see mxnet_tpu.telemetry."""
 from __future__ import annotations
 
 from .symbol import Executor  # noqa: F401

@@ -16,11 +16,12 @@ from __future__ import annotations
 
 import re
 import threading
+import time as _time
 
 import jax
 import numpy as onp
 
-from ..base import MXNetError, state
+from ..base import MXNetError, state, telem_flags as _telem
 from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray, array, _wrap
 from .. import ndarray as nd
@@ -448,9 +449,20 @@ class CachedOp:
                _amp.patch_epoch(),
                tuple(name for name, _ in params))
         entry = self._cache.get(key)
+        compiled_now = False
         if entry is None:
+            t0 = _time.perf_counter()
             entry = self._build(params, inputs, state.is_training)
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.record_compile(
+                    f"cachedop:{self.block.name}", repr(key[0]),
+                    _time.perf_counter() - t0)
+                compiled_now = True
             self._cache[key] = entry
+        elif _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.record_cache_hit(f"cachedop:{self.block.name}")
         jitted, aux_names = entry
 
         param_datas = {name: p.data(ctx)._data for name, p in params}
@@ -468,8 +480,17 @@ class CachedOp:
             return tuple(outs) + tuple(aux)
 
         all_inputs = param_arrs + input_arrs
+        t0 = _time.perf_counter()
         out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(
             run, tuple(all_inputs), {})
+        if compiled_now:
+            # _build only traced (jit is lazy): the first execution is
+            # where XLA actually lowers and compiles — that is the cost
+            # the recompile counters must show, not the trace time
+            from .. import telemetry as _telemetry
+            _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
+                _time.perf_counter() - t0,
+                site=f"cachedop:{self.block.name}")
         n_aux = len(aux_names)
         if n_aux:
             outs_flat, aux = out_data[:-n_aux], out_data[-n_aux:]

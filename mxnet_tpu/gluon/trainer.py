@@ -8,7 +8,7 @@ eager/multi-context path the kvstore reduces across device copies
 """
 from __future__ import annotations
 
-from ..base import MXNetError
+from ..base import MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
 from .. import kvstore as kvs
@@ -42,6 +42,12 @@ class Trainer:
             p._stype != 'default' for p in self._params)
         self._contains_sparse_grad = any(
             p._grad_stype != 'default' for p in self._params)
+        # telemetry: perf_counter of the previous step() call — the
+        # inter-step interval is the true iteration time (fwd+bwd+update).
+        # The EMA guards the histogram against counting pauses between
+        # steps (eval pass, checkpoint save) as step time.
+        self._telem_last_step = None
+        self._telem_step_ema = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -104,11 +110,37 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Gradient sync + optimizer update (ref: trainer.py:320)."""
+        if _telem['on']:
+            import time as _time
+            from .. import telemetry as _telemetry
+            now = _time.perf_counter()
+            last, ema = self._telem_last_step, self._telem_step_ema
+            self._telem_last_step = now
+            if last is not None:
+                dt = now - last
+                if ema is None:
+                    # the first interval seeds the filter but is NOT
+                    # recorded: it typically contains the step compile
+                    # (and may contain a pause), either of which would
+                    # poison both the histogram and the EMA baseline
+                    self._telem_step_ema = dt
+                elif dt <= 20.0 * ema:
+                    _telemetry.record_step(dt, batch_size)
+                    self._telem_step_ema = 0.9 * ema + 0.1 * dt
+                # else: >20x the running step time is a pause (eval,
+                # checkpoint) or a recompile spike, not a step — keep it
+                # out of the histogram and the samples/sec + MFU gauges
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def reset_step_timer(self):
+        """Forget the previous step() timestamp so an intervening pause
+        (validation pass, checkpoint save) is not measured as step time
+        by the telemetry step histogram. Call after any long gap."""
+        self._telem_last_step = None
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -282,6 +314,9 @@ class Trainer:
                              static_argnums=(6,))
             self._fused_cache = (sig, fused, jitted)
             self._fused_traced = False
+        elif _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.record_cache_hit('trainer:fused_update')
         _, fused_fn, jitted = self._fused_cache
 
         # host-side per-step scalars (counts first, as the reference does);
@@ -300,17 +335,25 @@ class Trainer:
         states_flat = []
         for i in indices:
             _flat(updater.states[i], states_flat)
-        if not getattr(self, '_fused_traced', False):
+        was_traced = getattr(self, '_fused_traced', False)
+        if not was_traced:
             # probe traceability ABSTRACTLY first: eval_shape consumes no
             # buffers, so a trace failure here can still fall back to the
             # eager loop with every weight/state intact. The real jitted
             # call below donates its inputs — after it dispatches there is
             # nothing to fall back TO, so its errors propagate.
             try:
+                import time as _time
+                t0 = _time.perf_counter()
                 jax.eval_shape(lambda w, g, s, a, b, c: fused_fn(
                     w, g, s, a, b, c, wds), weights, grads, states_flat,
                     lrs, ts, rescale)
                 self._fused_traced = True
+                if _telem['on']:
+                    from .. import telemetry as _telemetry
+                    _telemetry.record_compile(
+                        'trainer:fused_update', repr(sig),
+                        _time.perf_counter() - t0)
             except Exception:
                 import os
                 if os.environ.get('MXNET_TPU_FUSED_DEBUG'):
@@ -326,8 +369,16 @@ class Trainer:
                 self._fused_disabled = True
                 self._fused_cache = None
                 return False
+        import time as _time
+        t0 = _time.perf_counter()
         new_w, new_s = jitted(weights, grads, states_flat, lrs,
                               ts, rescale, wds)
+        if _telem['on'] and not was_traced:
+            # first execution after a (re)trace: jit is lazy, so this is
+            # where XLA actually compiles — account it as compile time
+            from .. import telemetry as _telemetry
+            _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
+                _time.perf_counter() - t0, site='trainer:fused_update')
         for (_, _, _, datas), w in zip(items, new_w):
             datas[0]._data = w
         pos = 0

@@ -9,11 +9,12 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import time as _time
 import queue as _queue
 
 import numpy as onp
 
-from ..base import MXNetError
+from ..base import MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray, array
 
 
@@ -66,7 +67,17 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        if not _telem['on']:
+            return self.next()
+        # batch-latency histogram: time the host side of producing one
+        # batch (decode/augment/copy), the IO half of any input stall
+        from .. import telemetry as _telemetry
+        t0 = _time.perf_counter()
+        batch = self.next()
+        _telemetry.observe('mxnet_tpu_io_batch_latency_seconds',
+                           _time.perf_counter() - t0)
+        _telemetry.inc('mxnet_tpu_io_batches_total')
+        return batch
 
     def iter_next(self):
         raise NotImplementedError
@@ -269,7 +280,21 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
-        batch = self._queue.get()
+        if _telem['on'] and self._queue.empty():
+            # prefetch miss: the background thread hasn't kept up — the
+            # consumer stalls for however long the get() blocks. Waiting
+            # for the end-of-epoch sentinel is not a miss: a pipeline
+            # that kept up perfectly still ends every epoch on one.
+            t0 = _time.perf_counter()
+            batch = self._queue.get()
+            if batch is not None:
+                from .. import telemetry as _telemetry
+                _telemetry.inc('mxnet_tpu_io_prefetch_miss_total')
+                _telemetry.counter(
+                    'mxnet_tpu_io_prefetch_stall_seconds_total').inc(
+                    _time.perf_counter() - t0)
+        else:
+            batch = self._queue.get()
         if batch is None:
             raise StopIteration
         return batch

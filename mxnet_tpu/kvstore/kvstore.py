@@ -18,10 +18,29 @@ import pickle
 
 import jax
 
-from ..base import MXNetError
+from ..base import MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
 from .base import KVStoreBase
+
+
+def _nbytes(arr):
+    d = arr._data
+    return int(d.size) * d.dtype.itemsize
+
+
+def _telem_push(k, vlist):
+    from .. import telemetry
+    telemetry.inc('mxnet_tpu_kvstore_push_total', key=str(k))
+    telemetry.counter('mxnet_tpu_kvstore_push_bytes_total').inc(
+        sum(_nbytes(v) for v in vlist), key=str(k))
+
+
+def _telem_pull(k, outs):
+    from .. import telemetry
+    telemetry.inc('mxnet_tpu_kvstore_pull_total', key=str(k))
+    telemetry.counter('mxnet_tpu_kvstore_pull_bytes_total').inc(
+        sum(_nbytes(o) for o in outs), key=str(k))
 
 
 class KVStore(KVStoreBase):
@@ -44,6 +63,8 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, vlist in _group(keys, values):
+            if _telem['on']:
+                _telem_push(k, vlist)
             merged = _reduce(vlist)
             if self._compression is not None:
                 merged = self._compression.compress_decompress(merged, k)
@@ -60,11 +81,17 @@ class KVStore(KVStoreBase):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             src = self._store[k]
-            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+            dsts = o if isinstance(o, (list, tuple)) else [o]
+            if _telem['on']:
+                _telem_pull(k, dsts)
+            for dst in dsts:
                 dst._data = jax.device_put(src._data,
                                            list(dst._data.devices())[0])
 
     def pushpull(self, key, value, out=None, priority=0):
+        if _telem['on']:
+            from .. import telemetry
+            telemetry.inc('mxnet_tpu_kvstore_pushpull_total')
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
@@ -172,6 +199,8 @@ class DistSync(KVStore):
         keys, values = _key_value(key, value)
         nproc = jax.process_count()
         for k, vlist in _group(keys, values):
+            if _telem['on']:
+                _telem_push(k, vlist)
             merged = _reduce(vlist)
             if nproc > 1:
                 from jax.experimental import multihost_utils

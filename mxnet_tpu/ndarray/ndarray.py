@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from ..base import MXNetError, state, get_op
+from ..base import MXNetError, state, get_op, telem_flags as _telem
 from ..context import Context, current_context
 from .. import _imperative
 from ..ops import (elemwise as _ew, reduce as _red, matrix as _mat, nn as _nn,
@@ -130,6 +130,10 @@ class NDArray:
 
     # ---- host interop -----------------------------------------------------
     def asnumpy(self) -> onp.ndarray:
+        if _telem['on']:
+            # device->host materialization is the dominant sync point in
+            # real training loops (loss.asnumpy() every step)
+            _timed_sync(self._data)
         return onp.asarray(self._data)
 
     def asscalar(self):
@@ -151,9 +155,15 @@ class NDArray:
         return self.shape[0]
 
     def wait_to_read(self):
+        if _telem['on']:
+            _timed_sync(self._data)
+            return
         jax.block_until_ready(self._data)
 
     def wait_to_write(self):
+        if _telem['on']:
+            _timed_sync(self._data)
+            return
         jax.block_until_ready(self._data)
 
     def __repr__(self):
@@ -577,9 +587,30 @@ def to_dlpack_for_read(arr):
     return arr.to_dlpack_for_read()
 
 
+def _timed_sync(data):
+    """block_until_ready with the stall reported to telemetry (the analog
+    of the reference engine's WaitForVar accounting)."""
+    import time as _time
+    from .. import telemetry as _telemetry
+    t0 = _time.perf_counter()
+    jax.block_until_ready(data)
+    _telemetry.inc('mxnet_tpu_sync_total')
+    _telemetry.counter('mxnet_tpu_sync_seconds_total').inc(
+        _time.perf_counter() - t0)
+
+
 def waitall():
     """Ref: Engine::WaitForAll — barrier on all outstanding async work."""
     try:
+        if _telem['on']:
+            import time as _time
+            from .. import telemetry as _telemetry
+            t0 = _time.perf_counter()
+            jax.effects_barrier()
+            _telemetry.inc('mxnet_tpu_sync_total')
+            _telemetry.counter('mxnet_tpu_sync_seconds_total').inc(
+                _time.perf_counter() - t0)
+            return
         jax.effects_barrier()
     except Exception:
         pass

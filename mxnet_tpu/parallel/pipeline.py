@@ -30,7 +30,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:    # jax < 0.6 ships it under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ['pipeline_forward', 'pipeline_loss_fn', 'stack_stage_params',
@@ -116,13 +119,15 @@ def pipeline_forward(stage_fn, stage_params, x_microbatches, mesh,
         return lax.psum(outs, pp_axis)
 
     pp_spec = P(pp_axis)
-    return shard_map(
-        spmd, mesh=mesh,
-        in_specs=(jax.tree_util.tree_map(lambda _: pp_spec, stage_params),
-                  P()),
-        out_specs=P(),
-        check_vma=False,
-    )(stage_params, x_microbatches)
+    in_specs = (jax.tree_util.tree_map(lambda _: pp_spec, stage_params),
+                P())
+    try:
+        mapped = shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                           out_specs=P(), check_vma=False)
+    except TypeError:   # jax < 0.7 spells the unchecked mode check_rep
+        mapped = shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                           out_specs=P(), check_rep=False)
+    return mapped(stage_params, x_microbatches)
 
 
 def pipeline_loss_fn(stage_fn, loss_fn, mesh, pp_axis='pp'):

@@ -33,7 +33,12 @@ _config = {
     # the old MXNET_TPU_JAX_TRACE_DIR env-only path (still honored)
     'jax_trace_dir': None,
 }
-_state = {'running': False, 'jax_trace_dir': None}
+_state = {'running': False, 'jax_trace_dir': None,
+          # whether THIS run has already dumped to the configured file:
+          # continuous_dump only extends a file this run wrote — a
+          # leftover trace from a previous run/process is overwritten,
+          # never merged into the new timeline
+          'dumped_in_run': False}
 _events = []
 _events_lock = threading.Lock()
 # op name -> [count, total_us, min_us, max_us] (aggregate_stats)
@@ -109,9 +114,12 @@ def set_state(state='stop', profile_process='worker'):
 
 def start(profile_process='worker'):
     _state['running'] = True
-    _events.clear()
     with _events_lock:
+        # both clears under the lock: a worker thread appending through
+        # record_op/_emit must never interleave with a half-done reset
+        _events.clear()
         _op_stats.clear()
+    _state['dumped_in_run'] = False
     _sync_flags()
     from . import config as _envcfg
     tdir = _config['jax_trace_dir'] or \
@@ -139,12 +147,42 @@ def resume(profile_process='worker'):
     _sync_flags()
 
 
+def _telemetry_events():
+    """Telemetry counters/gauges as chrome 'C' events, merged into the
+    trace stream so the counter tracks render alongside the op scopes."""
+    try:
+        from . import telemetry
+        if telemetry.enabled():
+            return telemetry.chrome_events()
+    except Exception:
+        pass
+    return []
+
+
 def dump(finished=True, profile_process='worker'):
-    """Write chrome://tracing JSON (ref: profiler.h:79 'chrome tracing')."""
+    """Write chrome://tracing JSON (ref: profiler.h:79 'chrome tracing').
+
+    With continuous_dump set, events already written are cleared from
+    memory and the on-disk trace is extended in place, so repeated dumps
+    neither re-emit nor unboundedly regrow the same trace."""
+    continuous = _config['continuous_dump']
     with _events_lock:
-        trace = {'traceEvents': list(_events), 'displayTimeUnit': 'ms'}
+        new_events = list(_events)
+        if continuous:
+            _events.clear()
+    events = new_events + _telemetry_events()
+    if continuous and _state['dumped_in_run'] \
+            and os.path.exists(_config['filename']):
+        try:
+            with open(_config['filename']) as f:
+                prev = json.load(f).get('traceEvents', [])
+        except (OSError, ValueError):
+            prev = []
+        events = prev + events
+    trace = {'traceEvents': events, 'displayTimeUnit': 'ms'}
     with open(_config['filename'], 'w') as f:
         json.dump(trace, f)
+    _state['dumped_in_run'] = True
 
 
 def dumps(reset=False, format='table'):
@@ -158,11 +196,11 @@ def dumps(reset=False, format='table'):
                 _events.clear()
         return out
     with _events_lock:
-        out = json.dumps({'traceEvents': list(_events)})
+        evs = list(_events)
         if reset:
             _events.clear()
             _op_stats.clear()
-    return out
+    return json.dumps({'traceEvents': evs + _telemetry_events()})
 
 
 def _emit(name, cat, ph, ts=None, args=None, dur=None):

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from .base import MXNetError, _OP_REGISTRY, get_op
+from .base import MXNetError, _OP_REGISTRY, get_op, telem_flags as _telem
 from .context import cpu
 from .ndarray.ndarray import NDArray, array, zeros as nd_zeros, _wrap
 
@@ -647,6 +647,10 @@ class Executor:
             _AlwaysOn(callback, monitor_all)
 
     def forward(self, is_train=False, **kwargs):
+        _t0 = None
+        if _telem['on']:
+            import time as _time
+            _t0 = _time.perf_counter()
         for k, v in kwargs.items():
             if isinstance(v, NDArray):
                 self.arg_dict[k]._data = v._data
@@ -681,6 +685,11 @@ class Executor:
             out = self._jit_fwd(bind)
             self._vjp = None
         self.outputs = [_wrap(out)]
+        if _t0 is not None:
+            from . import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_executor_forward_total')
+            _telemetry.observe('mxnet_tpu_executor_forward_seconds',
+                               _time.perf_counter() - _t0)
         return self.outputs
 
     def backward(self, out_grads=None):

@@ -56,6 +56,57 @@ def test_jax_trace_started_via_api(tmp_path):
     _reset()
 
 
+def test_start_clears_events_and_stats_atomically():
+    """ISSUE 1 satellite: start() must clear BOTH _events and _op_stats
+    under _events_lock — a stale event surviving into the new run is the
+    observable symptom of the old unlocked clear."""
+    profiler.set_config(profile_imperative=True, aggregate_stats=True)
+    profiler.start()
+    a = nd.ones((4, 4))
+    nd.dot(a, a)
+    profiler.stop()
+    assert json.loads(profiler.dumps(format='json'))['traceEvents']
+    profiler.start()   # must reset both stores
+    assert not json.loads(profiler.dumps(format='json'))['traceEvents']
+    summary = profiler.get_summary()
+    assert 'dot' not in summary
+    profiler.stop()
+    _reset()
+
+
+def test_continuous_dump_extends_file_without_reemitting(tmp_path):
+    """ISSUE 1 satellite: with continuous_dump, each dump() flushes only
+    new events; the on-disk trace accumulates them exactly once."""
+    fname = str(tmp_path / 'cont.json')
+    profiler.set_config(filename=fname, continuous_dump=True)
+    profiler.start()
+    with profiler.scope('s1'):
+        pass
+    profiler.dump()
+    first = json.load(open(fname))['traceEvents']
+    assert [e['name'] for e in first].count('s1') == 2   # B + E
+    with profiler.scope('s2'):
+        pass
+    profiler.dump()
+    evs = json.load(open(fname))['traceEvents']
+    names = [e['name'] for e in evs]
+    assert names.count('s1') == 2 and names.count('s2') == 2
+    # nothing re-emitted, nothing left in memory
+    profiler.dump()
+    assert len(json.load(open(fname))['traceEvents']) == 4
+    assert not json.loads(profiler.dumps(format='json'))['traceEvents']
+    # a NEW run overwrites the leftover file instead of merging into it
+    profiler.start()
+    with profiler.scope('s3'):
+        pass
+    profiler.dump()
+    names = [e['name'] for e in json.load(open(fname))['traceEvents']]
+    assert names.count('s3') == 2 and 's1' not in names
+    profiler.stop()
+    profiler.set_config(filename='profile.json', continuous_dump=False)
+    _reset()
+
+
 def test_scopes_and_counters_still_work(tmp_path):
     profiler.set_config(filename=str(tmp_path / 'p.json'))
     profiler.start()

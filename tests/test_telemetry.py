@@ -1,0 +1,430 @@
+"""Telemetry subsystem: registry semantics, exports, hot-path
+instrumentation, recompile detector, disabled-mode fast path
+(ISSUE 1 tentpole; ref for the shape: src/profiler/profiler.h — one sink
+every layer reports into)."""
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+import types
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, io, nd, telemetry
+
+
+@pytest.fixture()
+def telem():
+    """Clean, enabled registry; disabled and cleaned again afterwards."""
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+    telemetry.set_recompile_threshold(None)
+    telemetry.set_step_flops(None, None)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics(telem):
+    c = telemetry.counter('mxnet_tpu_test_requests_total')
+    c.inc()
+    c.inc(4)
+    c.inc(2, route='a')
+    assert c.value() == 5
+    assert c.value(route='a') == 2
+    assert c.value(route='missing') is None
+
+    g = telemetry.gauge('mxnet_tpu_test_temperature')
+    g.set(1.5)
+    g.set(2.5)
+    assert g.value() == 2.5
+
+    h = telemetry.histogram('mxnet_tpu_test_latency_seconds',
+                            buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    count, total = h.value()
+    assert count == 3 and total == 55.5
+
+    # get-or-create returns the same object; kind mismatch is an error
+    assert telemetry.counter('mxnet_tpu_test_requests_total') is c
+    with pytest.raises(mx.MXNetError):
+        telemetry.gauge('mxnet_tpu_test_requests_total')
+
+
+def test_metric_name_validation(telem):
+    for bad in ('requests_total', 'mxnet_tpu_CamelCase', 'mxnet_tpu_'):
+        with pytest.raises(mx.MXNetError):
+            telemetry.counter(bad)
+
+
+def test_reset_zeroes_values(telem):
+    telemetry.inc('mxnet_tpu_test_requests_total', 7)
+    telemetry.set_gauge('mxnet_tpu_test_temperature', 3.0)
+    telemetry.observe('mxnet_tpu_test_latency_seconds', 0.1)
+    assert telemetry.report() != ''
+    telemetry.reset()
+    assert telemetry.value('mxnet_tpu_test_requests_total') is None
+    assert telemetry.value('mxnet_tpu_test_latency_seconds') is None
+    assert telemetry.report() == ''
+
+
+# ---------------------------------------------------------------------------
+# exports: Prometheus / JSON / chrome-trace
+# ---------------------------------------------------------------------------
+
+def test_prometheus_golden(telem):
+    telemetry.counter('mxnet_tpu_test_golden_requests_total',
+                      help='requests').inc(3, route='a')
+    telemetry.set_gauge('mxnet_tpu_test_golden_temperature', 1.5)
+    h = telemetry.histogram('mxnet_tpu_test_golden_latency_seconds',
+                            buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    expected = (
+        '# TYPE mxnet_tpu_test_golden_latency_seconds histogram\n'
+        'mxnet_tpu_test_golden_latency_seconds_bucket{le="1.0"} 1\n'
+        'mxnet_tpu_test_golden_latency_seconds_bucket{le="10.0"} 2\n'
+        'mxnet_tpu_test_golden_latency_seconds_bucket{le="+Inf"} 3\n'
+        'mxnet_tpu_test_golden_latency_seconds_sum 55.5\n'
+        'mxnet_tpu_test_golden_latency_seconds_count 3\n'
+        '# HELP mxnet_tpu_test_golden_requests_total requests\n'
+        '# TYPE mxnet_tpu_test_golden_requests_total counter\n'
+        'mxnet_tpu_test_golden_requests_total{route="a"} 3\n'
+        '# TYPE mxnet_tpu_test_golden_temperature gauge\n'
+        'mxnet_tpu_test_golden_temperature 1.5\n'
+    )
+    assert telemetry.prometheus() == expected
+
+
+def test_json_dump_golden(telem, tmp_path):
+    telemetry.counter('mxnet_tpu_test_golden_requests_total',
+                      help='requests').inc(3, route='a')
+    h = telemetry.histogram('mxnet_tpu_test_golden_latency_seconds',
+                            buckets=(1.0, 10.0))
+    h.observe(0.5)
+    path = telemetry.dump(str(tmp_path / 'telemetry.json'))
+    doc = json.load(open(path))
+    assert doc['mxnet_tpu_test_golden_requests_total'] == {
+        'type': 'counter', 'help': 'requests',
+        'series': [{'labels': {'route': 'a'}, 'value': 3}]}
+    hist = doc['mxnet_tpu_test_golden_latency_seconds']
+    assert hist['type'] == 'histogram'
+    (series,) = hist['series']
+    assert series['count'] == 1 and series['sum'] == 0.5
+    assert series['buckets'] == {'1.0': 1, '10.0': 0, '+Inf': 0}
+
+
+def test_prometheus_label_escaping(telem):
+    telemetry.inc('mxnet_tpu_test_escapes_total',
+                  key='he said "hi"\nback\\slash')
+    out = telemetry.prometheus()
+    assert (r'mxnet_tpu_test_escapes_total'
+            r'{key="he said \"hi\"\nback\\slash"} 1') in out
+    # no literal newline may survive inside a sample line
+    assert all(line.count('"') % 2 == 0 or line.startswith('#')
+               for line in out.splitlines())
+
+
+def test_set_step_flops_clear_semantics(telem):
+    telemetry.set_step_flops(1e9, peak_flops=1e12)
+    telemetry.set_step_flops(2e9)            # omitted: peak kept
+    telemetry.record_step(0.01, 1)
+    assert telemetry.value('mxnet_tpu_mfu_percent') == pytest.approx(20.0)
+    telemetry.set_step_flops(2e9, peak_flops=None)   # explicit: cleared
+    telemetry.set_gauge('mxnet_tpu_mfu_percent', -1.0)
+    telemetry.record_step(0.01, 1)
+    assert telemetry.value('mxnet_tpu_mfu_percent') == -1.0  # not updated
+
+
+def test_chrome_counter_events_merge_into_profiler(telem, tmp_path):
+    from mxnet_tpu import profiler
+    telemetry.inc('mxnet_tpu_test_requests_total', 5)
+    telemetry.set_gauge('mxnet_tpu_test_temperature', 2.0)
+    fname = str(tmp_path / 'trace.json')
+    profiler.set_config(filename=fname)
+    profiler.start()
+    profiler.stop()
+    profiler.dump()
+    evs = json.load(open(fname))['traceEvents']
+    tel = [e for e in evs if e.get('cat') == 'telemetry']
+    assert all(e['ph'] == 'C' for e in tel)
+    names = {e['name'] for e in tel}
+    assert 'mxnet_tpu_test_requests_total' in names
+    assert 'mxnet_tpu_test_temperature' in names
+    # and in the dumps() JSON stream too
+    evs2 = json.loads(profiler.dumps(format='json'))['traceEvents']
+    assert any(e.get('cat') == 'telemetry' for e in evs2)
+    profiler.set_config(filename='profile.json')
+
+
+# ---------------------------------------------------------------------------
+# recompile detector
+# ---------------------------------------------------------------------------
+
+def test_recompile_detector_warns_exactly_once(telem):
+    telemetry.set_recompile_threshold(2)
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        for i in range(1, 7):        # 6 distinct batch shapes -> 6 compiles
+            net(nd.ones((i, 4)))
+    rec = [x for x in w if issubclass(x.category, telemetry.RecompileWarning)]
+    assert len(rec) == 1
+    msg = str(rec[0].message)
+    assert f'cachedop:{net.name}' in msg and 'float32' in msg
+    site = f'cachedop:{net.name}'
+    assert telemetry.value('mxnet_tpu_compile_total', site=site) == 6
+    assert telemetry.value('mxnet_tpu_recompile_warnings_total',
+                           site=site) == 1
+    # stable shapes from here on: cache hits, no further compiles
+    net(nd.ones((3, 4)))
+    assert telemetry.value('mxnet_tpu_compile_total', site=site) == 6
+    assert telemetry.value('mxnet_tpu_compile_cache_hits_total',
+                           site=site) >= 1
+
+
+def test_compile_seconds_counter(telem):
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 3)))
+    site = f'cachedop:{net.name}'
+    assert telemetry.value('mxnet_tpu_compile_seconds_total', site=site) > 0
+
+
+# ---------------------------------------------------------------------------
+# step metrics / MFU
+# ---------------------------------------------------------------------------
+
+def test_record_step_and_mfu_gauge(telem):
+    telemetry.set_step_flops(1e9, peak_flops=1e12)
+    telemetry.record_step(0.01, 32)
+    count, total = telemetry.value('mxnet_tpu_step_time_seconds')
+    assert count == 1 and total == pytest.approx(0.01)
+    assert telemetry.value('mxnet_tpu_samples_per_second') == \
+        pytest.approx(3200.0)
+    # 1e9 FLOPs in 10ms against a 1e12 FLOP/s peak = 10% MFU
+    assert telemetry.value('mxnet_tpu_mfu_percent') == pytest.approx(10.0)
+
+
+def test_speedometer_pulls_gauge_and_counts(telem, caplog):
+    # a just-recorded step marks the gauge fresh
+    telemetry.record_step(0.1, 123.45)     # -> 1234.5 samples/sec
+    sp = mx.callback.Speedometer(batch_size=8, frequent=1)
+    sp(types.SimpleNamespace(nbatch=0, epoch=0, eval_metric=None))
+    with caplog.at_level(logging.INFO):
+        sp(types.SimpleNamespace(nbatch=1, epoch=0, eval_metric=None))
+    assert '1234.50' in caplog.text
+    assert telemetry.value('mxnet_tpu_speedometer_logs_total') == 1
+
+
+def test_speedometer_ignores_stale_gauge(telem, caplog):
+    # gauge set long "ago" (no record_step timestamp): must recompute
+    telemetry.set_gauge('mxnet_tpu_samples_per_second', 99999.0)
+    sp = mx.callback.Speedometer(batch_size=8, frequent=1)
+    sp(types.SimpleNamespace(nbatch=0, epoch=0, eval_metric=None))
+    with caplog.at_level(logging.INFO):
+        sp(types.SimpleNamespace(nbatch=1, epoch=0, eval_metric=None))
+    assert '99999' not in caplog.text
+    assert 'samples/sec' in caplog.text
+
+
+def test_speedometer_recomputes_without_gauge(telem, caplog):
+    sp = mx.callback.Speedometer(batch_size=8, frequent=1)
+    sp(types.SimpleNamespace(nbatch=0, epoch=0, eval_metric=None))
+    with caplog.at_level(logging.INFO):
+        sp(types.SimpleNamespace(nbatch=1, epoch=0, eval_metric=None))
+    assert 'samples/sec' in caplog.text
+    assert telemetry.value('mxnet_tpu_speedometer_logs_total') == 1
+
+
+def test_trainer_step_pause_guard(telem):
+    """A long gap between step() calls (eval pass, checkpoint) must not
+    land in the step-time histogram."""
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.0}, kvstore=None)
+    x = nd.ones((2, 3))
+
+    def one_step():
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(2)
+
+    one_step()     # first step: no previous timestamp, nothing recorded
+    assert telemetry.value('mxnet_tpu_step_time_seconds') is None
+    # simulate a 10s pause against a 0.1s running step time: skipped
+    trainer._telem_step_ema = 0.1
+    trainer._telem_last_step = time.perf_counter() - 10.0
+    one_step()
+    assert telemetry.value('mxnet_tpu_step_time_seconds') is None
+    # a normal-length interval is recorded
+    trainer._telem_last_step = time.perf_counter() - 0.005
+    one_step()
+    count, total = telemetry.value('mxnet_tpu_step_time_seconds')
+    assert count == 1 and total < 2.0
+    trainer.reset_step_timer()
+    assert trainer._telem_last_step is None
+
+
+# ---------------------------------------------------------------------------
+# IO instrumentation
+# ---------------------------------------------------------------------------
+
+def test_io_batch_latency_histogram(telem):
+    X = onp.arange(32, dtype=onp.float32).reshape(16, 2)
+    it = io.NDArrayIter(X, None, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 4
+    count, _ = telemetry.value('mxnet_tpu_io_batch_latency_seconds')
+    assert count == 4
+    assert telemetry.value('mxnet_tpu_io_batches_total') == 4
+
+
+def test_prefetch_miss_and_stall_counters(telem):
+    class SlowIter(io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=1)
+            self.n = 0
+
+        def next(self):
+            if self.n >= 2:
+                raise StopIteration
+            self.n += 1
+            time.sleep(0.05)
+            return io.DataBatch(data=[nd.ones((1, 2))])
+
+    pf = io.PrefetchingIter(SlowIter())
+    got = 0
+    while True:
+        try:
+            pf.next()
+            got += 1
+        except StopIteration:
+            break
+    assert got == 2
+    # the producer sleeps before the first put: the consumer must have
+    # stalled at least once, and the stall time was accounted
+    assert telemetry.value('mxnet_tpu_io_prefetch_miss_total') >= 1
+    assert telemetry.value(
+        'mxnet_tpu_io_prefetch_stall_seconds_total') > 0
+
+
+# ---------------------------------------------------------------------------
+# executor instrumentation
+# ---------------------------------------------------------------------------
+
+def test_executor_forward_metrics(telem):
+    a = mx.sym.var('a')
+    b = a * 2
+    exe = b.simple_bind(ctx=mx.cpu(), a=(2, 2))
+    exe.forward(is_train=False, a=nd.ones((2, 2)))
+    exe.forward(is_train=False, a=nd.ones((2, 2)))
+    assert telemetry.value('mxnet_tpu_executor_forward_total') == 2
+    count, _ = telemetry.value('mxnet_tpu_executor_forward_seconds')
+    assert count == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: a small Trainer loop fills every hot-path metric
+# ---------------------------------------------------------------------------
+
+def test_training_loop_populates_report(telem):
+    rng = onp.random.RandomState(0)
+    X = rng.rand(32, 8).astype(onp.float32)
+    Y = rng.rand(32, 1).astype(onp.float32)
+    it = io.NDArrayIter(X, Y, batch_size=8)
+
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.01},
+                            update_on_kvstore=True)
+    for batch in it:
+        x, y = batch.data[0], batch.label[0]
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(8)
+
+    # op dispatch
+    assert telemetry.value('mxnet_tpu_imperative_ops_total') > 0
+    # compile cache
+    site = f'cachedop:{net.name}'
+    assert telemetry.value('mxnet_tpu_compile_total', site=site) >= 1
+    # kvstore bytes (update_on_kvstore pushes grads / pulls weights)
+    assert telemetry.value('mxnet_tpu_kvstore_push_bytes_total',
+                           key='0') > 0
+    assert telemetry.value('mxnet_tpu_kvstore_pull_bytes_total',
+                           key='0') > 0
+    # IO histogram
+    io_count, _ = telemetry.value('mxnet_tpu_io_batch_latency_seconds')
+    assert io_count == 4
+    # step-time histogram: 4 steps -> 3 inter-step intervals, the first
+    # of which only seeds the pause/compile filter and is not recorded
+    step_count, _ = telemetry.value('mxnet_tpu_step_time_seconds')
+    assert step_count == 2
+    assert telemetry.value('mxnet_tpu_samples_per_second') > 0
+
+    rep = telemetry.report()
+    for needle in ('mxnet_tpu_imperative_ops_total',
+                   'mxnet_tpu_compile_total',
+                   'mxnet_tpu_kvstore_push_bytes_total',
+                   'mxnet_tpu_io_batch_latency_seconds',
+                   'mxnet_tpu_step_time_seconds'):
+        assert needle in rep, f"report missing {needle}"
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+def test_disabled_leaves_zero_counters():
+    telemetry.reset()
+    telemetry.disable()
+    a = nd.ones((2, 2))
+    (a * 2).wait_to_read()
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((1, 4)))
+    X = onp.zeros((4, 2), onp.float32)
+    list(io.NDArrayIter(X, None, batch_size=2))
+    assert telemetry.value('mxnet_tpu_imperative_ops_total') is None
+    assert telemetry.value('mxnet_tpu_io_batches_total') is None
+    assert telemetry.report() == ''
+    assert telemetry.prometheus() == ''
+    assert not telemetry.enabled()
+
+
+def test_env_gate_declared():
+    assert 'MXNET_TPU_TELEMETRY' in mx.config.list_vars()
+    assert 'MXNET_TPU_RECOMPILE_WARN_THRESHOLD' in mx.config.list_vars()
+    assert mx.config.get('MXNET_TPU_RECOMPILE_WARN_THRESHOLD') >= 1
+
+
+# ---------------------------------------------------------------------------
+# CI lint: metric names unique, lowercase_snake, namespaced
+# ---------------------------------------------------------------------------
+
+def test_metric_name_lint():
+    tool = os.path.join(os.path.dirname(__file__), os.pardir,
+                        'tools', 'check_telemetry_names.py')
+    res = subprocess.run([sys.executable, tool], capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stderr

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Lint the telemetry metric names registered across the package.
+
+Every metric name used at an instrumentation site (telemetry.inc /
+set_gauge / observe / counter / gauge / histogram / value with a string
+literal) must be:
+
+- namespaced ``mxnet_tpu_*`` and lowercase_snake,
+- registered under exactly one metric kind (a name used both as a
+  counter and, say, a histogram is a registry collision waiting to
+  happen at runtime).
+
+Run from anywhere: ``python tools/check_telemetry_names.py``. Exit code 0
+when clean, 1 with one line per violation otherwise. Wired into the
+tier-1 pass via tests/test_telemetry.py::test_metric_name_lint.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r'^mxnet_tpu_[a-z][a-z0-9_]*$')
+
+# call name -> metric kind it implies (None: kind-agnostic read)
+KINDS = {
+    'inc': 'counter', 'counter': 'counter',
+    'set_gauge': 'gauge', 'gauge': 'gauge',
+    'observe': 'histogram', 'histogram': 'histogram',
+    'value': None,
+}
+
+CALL_RE = re.compile(
+    r"\b(inc|set_gauge|observe|counter|gauge|histogram|value)\(\s*"
+    r"'([^']+)'", re.S)
+
+
+def scan(pkg_dir):
+    """{name: {kind, ...}} plus [(path, lineno, name, problem), ...]."""
+    names = {}
+    errors = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as f:
+                src = f.read()
+            for m in CALL_RE.finditer(src):
+                call, name = m.group(1), m.group(2)
+                lineno = src.count('\n', 0, m.start()) + 1
+                if not NAME_RE.match(name):
+                    errors.append(
+                        (path, lineno, name,
+                         'not lowercase_snake / not namespaced mxnet_tpu_*'))
+                    continue
+                kind = KINDS[call]
+                if kind is not None:
+                    names.setdefault(name, set()).add(kind)
+    for name, kinds in sorted(names.items()):
+        if len(kinds) > 1:
+            errors.append(
+                ('<registry>', 0, name,
+                 f"registered under multiple kinds: {sorted(kinds)}"))
+    return names, errors
+
+
+def main(argv=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.join(os.path.dirname(here), 'mxnet_tpu')
+    names, errors = scan(pkg)
+    if errors:
+        for path, lineno, name, problem in errors:
+            print(f"{path}:{lineno}: metric {name!r}: {problem}",
+                  file=sys.stderr)
+        return 1
+    print(f"telemetry names OK: {len(names)} metrics, all unique, "
+          f"lowercase_snake, mxnet_tpu_* namespaced")
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
