@@ -35,6 +35,7 @@ from . import gluon
 from . import profiler
 from . import telemetry
 from . import callback
+from . import checkpoint
 from . import runtime
 from . import config
 from . import subgraph

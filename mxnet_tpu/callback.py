@@ -7,22 +7,61 @@ import time
 from .base import telem_flags as _telem
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      manager=None):
+    """Epoch-end checkpoint callback for Module.
+
+    With a ``checkpoint.CheckpointManager`` the save routes through the
+    fault-tolerant path instead of legacy prefix files: atomic manifest
+    commit, async write, retention, and optimizer states riding along
+    when ``save_optimizer_states`` is set."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            if manager is not None:
+                arg_params, aux_params = mod.get_params()
+                params = {f'arg:{k}': v for k, v in arg_params.items()}
+                params.update({f'aux:{k}': v for k, v in aux_params.items()})
+                states = mod._updater.get_states(dump_optimizer=True) \
+                    if save_optimizer_states and mod._updater is not None \
+                    else None
+                # the symbol rides along so the checkpoint alone can
+                # reconstruct the network (legacy path's -symbol.json)
+                extra = {}
+                symbol = sym if sym is not None \
+                    else getattr(mod, '_symbol', None)
+                if symbol is not None:
+                    extra['symbol'] = symbol.tojson().encode('utf-8')
+                manager.save(iter_no + 1, params=params, states=states,
+                             extra_blobs=extra)
+            else:
+                mod.save_checkpoint(prefix, iter_no + 1,
+                                    save_optimizer_states)
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
+def do_checkpoint(prefix, period=1, manager=None):
+    """Epoch-end checkpoint callback for the symbolic fit path. With a
+    ``checkpoint.CheckpointManager`` the arg/aux params go through the
+    atomic async manager (keyed ``arg:``/``aux:`` like save_checkpoint)
+    instead of a bare prefix-NNNN.params file."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            from .model import save_checkpoint
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            if manager is not None:
+                params = {f'arg:{k}': v for k, v in (arg or {}).items()}
+                params.update(
+                    {f'aux:{k}': v for k, v in (aux or {}).items()})
+                extra = {'symbol': sym.tojson().encode('utf-8')} \
+                    if sym is not None else None
+                manager.save(iter_no + 1, params=params,
+                             metadata={'prefix': prefix},
+                             extra_blobs=extra)
+            else:
+                from .model import save_checkpoint
+                save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
     return _callback
 
 

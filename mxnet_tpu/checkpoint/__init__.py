@@ -1,0 +1,19 @@
+"""Fault-tolerant async checkpointing.
+
+``CheckpointManager`` snapshots params + optimizer state + step + RNG
+state on the training thread, writes atomically (per-array files + a
+hashed JSON manifest committed by one ``os.replace``) on a background
+thread, enforces keep-last-N / keep-every-K retention, and resumes via
+hash-verified ``restore_latest()`` with fallback to the previous
+committed step on corruption. See manager.py / manifest.py, the README
+"Checkpointing" section, and ``tools/check_checkpoint_manifest.py``.
+"""
+from .manifest import (CorruptCheckpointError, atomic_write_bytes,
+                       committed_steps, read_manifest, step_dir_name,
+                       validate_step_dir)
+from .manager import CheckpointManager, RestoredCheckpoint
+
+__all__ = ['CheckpointManager', 'RestoredCheckpoint',
+           'CorruptCheckpointError', 'atomic_write_bytes',
+           'committed_steps', 'read_manifest', 'step_dir_name',
+           'validate_step_dir']

@@ -1,0 +1,586 @@
+"""Fault-tolerant async CheckpointManager.
+
+Orbax/Check-N-Run-style checkpointing for mxnet_tpu training loops:
+
+- **Async**: ``save(step)`` snapshots params + optimizer state + step +
+  RNG state to host memory on the calling (training) thread, then a
+  background thread serializes, hashes and commits — the training step
+  only pays the device→host copy (and any wait for a previous in-flight
+  save). Telemetry reports both numbers so the overlap is auditable:
+  ``mxnet_tpu_checkpoint_blocked_seconds`` (training thread) vs
+  ``mxnet_tpu_checkpoint_save_seconds`` (end-to-end).
+- **Atomic**: per-array reference-format files + a JSON manifest with
+  sha256 content hashes are written into ``step_NNNNNNNNNN.tmp-<pid>``
+  and committed with one ``os.replace`` (see manifest.py for the
+  protocol). A kill at any instant leaves either the previous committed
+  checkpoint intact or a tmp dir that readers never look at.
+- **Retention**: keep-last-N plus keep-every-K-steps; GC deletes only
+  committed-but-expired steps (never an in-flight tmp write) and sweeps
+  stale tmp dirs left by killed processes.
+- **Preemption-safe resume**: ``restore_latest()`` re-verifies every
+  content hash and silently falls back to the previous committed step on
+  corruption; ``install_preemption_hook()`` wires SIGTERM to an
+  immediate synchronous ``save_now()``.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import signal as _signal
+import threading
+import time as _time
+import warnings
+from typing import Any, Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError, telem_flags as _telem
+from . import manifest as mf
+from .manifest import CorruptCheckpointError
+
+__all__ = ['CheckpointManager', 'RestoredCheckpoint', 'CorruptCheckpointError']
+
+# test-only fault-injection points (tests/test_checkpoint.py): name -> fn(path)
+#   'after_arrays'  — payload files written, manifest not yet
+#   'before_commit' — manifest written, final os.replace not yet
+#   'during_write'  — once per payload file, before its bytes hit disk
+_TEST_HOOKS: Dict[str, Any] = {}
+
+
+def _run_hook(name: str, path: str) -> None:
+    fn = _TEST_HOOKS.get(name)
+    if fn is not None:
+        fn(path)
+
+
+def _snapshot_params(target) -> Dict[str, onp.ndarray]:
+    """Normalize a params-like object into {name: host numpy array}.
+
+    Accepts a gluon Block, a ParameterDict, a plain dict of
+    Parameter/NDArray/numpy values, or a zero-arg callable returning any
+    of those. This is the device→host copy — the only work the training
+    thread pays for an async save."""
+    if target is None:
+        return {}
+    if callable(target) and not hasattr(target, 'items') \
+            and not hasattr(target, '_collect_params_with_prefix'):
+        target = target()
+    if hasattr(target, '_collect_params_with_prefix'):   # gluon Block
+        target = target._collect_params_with_prefix()
+    if not hasattr(target, 'items'):
+        raise MXNetError(
+            f"checkpoint params must be a Block, ParameterDict or dict, "
+            f"got {type(target)}")
+    out = {}
+    for name, v in target.items():
+        if hasattr(v, 'data') and hasattr(v, '_data'):   # Parameter
+            if v._data is None:
+                raise MXNetError(
+                    f"checkpoint: parameter '{name}' is uninitialized")
+            v = v.data()
+        if hasattr(v, 'asnumpy'):                        # NDArray
+            v = onp.asarray(v.asnumpy())
+        else:
+            # plain numpy is user-mutable in place: copy, or the async
+            # writer serializes a torn mid-update state that still
+            # hash-validates (NDArray paths are immutable snapshots)
+            v = onp.array(v, copy=True)
+        out[str(name)] = v
+    return out
+
+
+def _apply_params(target, loaded: Dict[str, onp.ndarray], strict: bool):
+    """Write restored host arrays back into a params-like object."""
+    from ..context import cpu
+    from ..ndarray.ndarray import array
+    if hasattr(target, '_collect_params_with_prefix'):
+        target = target._collect_params_with_prefix()
+    for name, p in target.items():
+        if name not in loaded:
+            if strict:
+                raise MXNetError(
+                    f"checkpoint restore: parameter '{name}' missing from "
+                    f"checkpoint (pass strict=False to skip)")
+            continue
+        v = loaded[name]
+        if hasattr(p, 'set_data') and hasattr(p, '_data'):  # Parameter
+            if p._data is None and not p._deferred_init:
+                p.shape = v.shape
+                p.initialize(ctx=[cpu(0)])
+            p.set_data(array(v))
+        elif hasattr(p, '_data'):                            # NDArray
+            p._data = array(v)._data
+        else:
+            target[name] = array(v)
+
+
+class RestoredCheckpoint:
+    """What ``restore_latest()`` hands back: the committed step plus the
+    validated payloads (host numpy params, opaque state blobs, manifest
+    metadata, RNG state)."""
+
+    def __init__(self, step, directory, params, blobs, metadata, rng):
+        self.step = step
+        self.directory = directory
+        self.params = params          # {name: numpy}
+        self.blobs = blobs            # {name: bytes} ('trainer_states', ...)
+        self.metadata = metadata
+        self.rng = rng
+
+    @property
+    def trainer_states(self) -> Optional[bytes]:
+        return self.blobs.get('trainer_states')
+
+    def __repr__(self):
+        return (f"<RestoredCheckpoint step={self.step} "
+                f"arrays={len(self.params)} blobs={sorted(self.blobs)}>")
+
+
+class CheckpointManager:
+    """Async, atomic, retained checkpoints for a training loop.
+
+    ::
+
+        mgr = checkpoint.CheckpointManager(
+            'ckpts/', params=net, trainer=trainer,
+            keep_last_n=3, keep_every_k_steps=1000,
+            autosave_steps=500)
+        mgr.install_preemption_hook()            # SIGTERM -> save_now()
+        start = mgr.restore_latest() or 0        # resume (0 on fresh run)
+        for step in range(start, total):
+            ... train ...
+            mgr.maybe_save(step + 1)             # autosave cadence
+        mgr.close()
+
+    ``restore_latest()`` returns the restored step number when ``params``
+    / ``trainer`` are bound (state applied in place), or a
+    ``RestoredCheckpoint`` when called with ``apply=False``.
+    """
+
+    def __init__(self, directory: str, params=None, trainer=None,
+                 keep_last_n: int = 3, keep_every_k_steps: Optional[int] = None,
+                 autosave_steps: Optional[int] = None,
+                 autosave_seconds: Optional[float] = None,
+                 async_save: bool = True, save_rng: bool = True):
+        if keep_last_n < 1:
+            raise MXNetError("keep_last_n must be >= 1 (the latest "
+                             "checkpoint can never be retention-expired)")
+        if keep_every_k_steps is not None and keep_every_k_steps < 1:
+            raise MXNetError("keep_every_k_steps must be >= 1")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._params = params
+        self._trainer = trainer
+        self.keep_last_n = int(keep_last_n)
+        self.keep_every_k_steps = keep_every_k_steps
+        self.autosave_steps = autosave_steps
+        self.autosave_seconds = autosave_seconds
+        self.async_save = bool(async_save)
+        self.save_rng = bool(save_rng)
+        self.preempted = False
+        self._current_step = None
+        self._last_autosave_time = _time.monotonic()
+        self._pending: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        # RLock: a SIGTERM arriving while the main thread is inside save()
+        # re-enters via the handler's save_now() on the same thread
+        self._lock = threading.RLock()    # serializes save entry points
+        self._in_signal_save = False
+        self._in_save = False
+        self._old_handlers = {}
+        # a crashed predecessor may have left partial tmp writes (swept)
+        # or a half-finished same-step re-save swap (recovered) behind;
+        # nothing of ours is in flight yet, so pid-reuse leftovers go too
+        self._recover_and_sweep(sweep_own=True)
+
+    # -- introspection ----------------------------------------------------
+
+    def all_steps(self):
+        return mf.committed_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, mf.step_dir_name(step))
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, step: int, params=None, states: Optional[bytes] = None,
+             metadata: Optional[dict] = None, block: bool = False,
+             extra_blobs: Optional[Dict[str, bytes]] = None) -> None:
+        """Checkpoint `step`. Snapshots state on the calling thread, then
+        (async mode) hands the write to a background thread. `params` /
+        `states` override the bound providers for this call only;
+        `extra_blobs` adds opaque byte payloads (e.g. a symbol JSON) that
+        ride in the manifest next to the trainer states."""
+        t_blocked0 = _time.perf_counter()
+        with self._lock:
+            self._current_step = int(step)
+            # back-pressure: at most one write in flight — a second save
+            # waits for the first (that wait is honest blocked time)
+            self._join_pending()
+            # a previous async write's failure surfaces here, after its
+            # thread is joined (reading _error earlier would race the
+            # writer and could swallow the failure for good)
+            self._reraise_write_error()
+            self._in_save = True
+            try:
+                snapshot = self._snapshot(step, params, states, metadata,
+                                          extra_blobs)
+                if self.async_save and not block:
+                    t = threading.Thread(
+                        target=self._write_and_commit,
+                        args=(snapshot, _time.perf_counter()),
+                        name=f'ckpt-write-{step}', daemon=True)
+                    self._pending = t
+                    t.start()
+                else:
+                    self._write_and_commit(snapshot, _time.perf_counter())
+                    self._reraise_write_error()
+            finally:
+                self._in_save = False
+        blocked = _time.perf_counter() - t_blocked0
+        self._last_autosave_time = _time.monotonic()
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.observe('mxnet_tpu_checkpoint_blocked_seconds',
+                               blocked)
+
+    def save_now(self, step: Optional[int] = None, **kwargs) -> None:
+        """Synchronous save (used by the SIGTERM hook): returns only once
+        the checkpoint is committed and durable."""
+        if step is None:
+            step = self._current_step
+        if step is None:
+            raise MXNetError("save_now: no step given and no prior save/"
+                             "maybe_save call to infer it from")
+        self.save(step, block=True, **kwargs)
+
+    def maybe_save(self, step: int, metadata: Optional[dict] = None) -> bool:
+        """Autosave cadence: call once per training step. Saves when the
+        steps/seconds cadence fires (or a preemption signal arrived before
+        the hook could save synchronously). Returns True when it saved."""
+        self._current_step = int(step)
+        due = False
+        if self.autosave_steps and step % self.autosave_steps == 0:
+            due = True
+        if self.autosave_seconds is not None and \
+                _time.monotonic() - self._last_autosave_time \
+                >= self.autosave_seconds:
+            due = True
+        if self.preempted and self.latest_step() != int(step):
+            due = True
+        if due:
+            self.save(step, metadata=metadata, block=self.preempted)
+        return due
+
+    def wait(self) -> None:
+        """Block until any in-flight async write has committed."""
+        with self._lock:
+            self._join_pending()
+        self._reraise_write_error()
+
+    def _join_pending(self):
+        t = self._pending
+        if t is not None and t.is_alive():
+            t.join()
+        self._pending = None
+
+    def _reraise_write_error(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise MXNetError(
+                f"checkpoint background write failed: {err!r}") from err
+
+    def _snapshot(self, step, params, states, metadata,
+                  extra_blobs=None) -> dict:
+        arrays = _snapshot_params(
+            params if params is not None else self._params)
+        blobs = dict(extra_blobs or {})
+        if states is not None:
+            blobs['trainer_states'] = states
+        elif self._trainer is not None:
+            blobs['trainer_states'] = self._trainer.get_states_bytes()
+        rng = None
+        if self.save_rng:
+            from .. import random as _random
+            rng = _random.get_state()
+        return {'step': int(step), 'arrays': arrays, 'blobs': blobs,
+                'rng': rng, 'metadata': metadata or {}}
+
+    def _write_and_commit(self, snap: dict, t_start: float) -> None:
+        try:
+            total_bytes = self._write_step(snap)
+        except BaseException as e:  # surfaced on the training thread
+            self._error = e
+            # a failed same-step re-save may have retired the committed
+            # copy aside (.old-) — roll it back now so the LIVE manager
+            # still sees the step (single writer: nothing else in flight)
+            try:
+                self._recover_and_sweep(sweep_own=True)
+            except OSError:
+                pass
+            return
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.observe('mxnet_tpu_checkpoint_save_seconds',
+                               _time.perf_counter() - t_start)
+            _telemetry.inc('mxnet_tpu_checkpoint_saves_total')
+            _telemetry.set_gauge('mxnet_tpu_checkpoint_bytes', total_bytes)
+            _telemetry.set_gauge('mxnet_tpu_checkpoint_last_step',
+                                 snap['step'])
+
+    def _write_step(self, snap: dict) -> int:
+        from ..serialization import save_ndarray_file
+        step = snap['step']
+        final = self.step_dir(step)
+        tmp = f'{final}.tmp-{os.getpid()}'
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(os.path.join(tmp, 'arrays'))
+        os.makedirs(os.path.join(tmp, 'blobs'))
+        total = 0
+        arr_entries = []
+        for i, (name, arr) in enumerate(snap['arrays'].items()):
+            rel = f'arrays/a{i:05d}.nd'
+            payload = save_ndarray_file({name: arr})
+            _run_hook('during_write', os.path.join(tmp, rel))
+            mf.write_bytes_durable(os.path.join(tmp, rel), payload)
+            arr_entries.append({
+                'name': name, 'file': rel, 'bytes': len(payload),
+                'sha256': mf.sha256_bytes(payload),
+                'shape': list(arr.shape), 'dtype': str(arr.dtype)})
+            total += len(payload)
+        blob_entries = []
+        for name, data in snap['blobs'].items():
+            if '/' in name or os.sep in name or name.startswith('.'):
+                raise MXNetError(f"checkpoint blob name {name!r} must be "
+                                 f"a plain filename component")
+            rel = f'blobs/{name}.bin'
+            _run_hook('during_write', os.path.join(tmp, rel))
+            mf.write_bytes_durable(os.path.join(tmp, rel), data)
+            blob_entries.append({
+                'name': name, 'file': rel, 'bytes': len(data),
+                'sha256': mf.sha256_bytes(data)})
+            total += len(data)
+        _run_hook('after_arrays', tmp)
+        mf.write_manifest(tmp, {
+            'step': step, 'arrays': arr_entries, 'blobs': blob_entries,
+            'rng': snap['rng'], 'metadata': snap['metadata'],
+            'save_time_unix': _time.time(), 'total_bytes': total})
+        mf.fsync_dir(os.path.join(tmp, 'arrays'))
+        mf.fsync_dir(os.path.join(tmp, 'blobs'))
+        mf.fsync_dir(tmp)
+        _run_hook('before_commit', tmp)
+        # the commit point: one rename makes the whole step visible.
+        # Re-saving an existing step cannot swap atomically (rename(2)
+        # refuses non-empty targets), so the committed copy is retired
+        # aside first and deleted only after the new copy commits — a
+        # crash anywhere in between is recovered from the .old dir by
+        # the next manager's _recover_and_sweep.
+        old = None
+        if os.path.isdir(final):
+            old = f'{final}.old-{os.getpid()}'
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.replace(final, old)
+            _run_hook('after_retire_old', old)
+        os.replace(tmp, final)
+        mf.fsync_dir(self.directory)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        self._gc()
+        return total
+
+    # -- retention / GC ---------------------------------------------------
+
+    def _retained(self, steps):
+        keep = set(steps[-self.keep_last_n:])
+        if self.keep_every_k_steps:
+            keep.update(s for s in steps
+                        if s % self.keep_every_k_steps == 0)
+        return keep
+
+    def _gc(self) -> int:
+        """Delete committed-but-expired steps per the retention policy.
+        Only ever touches committed dirs (and stale tmp dirs from dead
+        writers) — never the in-flight write."""
+        steps = self.all_steps()
+        keep = self._retained(steps)
+        removed = 0
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self.step_dir(s), ignore_errors=True)
+                removed += 1
+        removed_tmp = self._recover_and_sweep(sweep_own=True)
+        if removed and _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_checkpoint_gc_total', removed)
+        return removed + removed_tmp
+
+    def _recover_and_sweep(self, sweep_own: bool = False) -> int:
+        """Handle leftovers of dead writers: recover a committed step
+        whose re-save swap died mid-way (``.old-`` dir present, final
+        dir missing → rename the old copy back), then sweep stale
+        ``.tmp-`` partial writes and superseded ``.old-`` copies."""
+        n = 0
+        for old, final in mf.stale_old_dirs(self.directory):
+            if not os.path.isdir(final):
+                try:
+                    os.replace(old, final)   # the swap died: roll back
+                    continue
+                except OSError:
+                    pass
+            shutil.rmtree(old, ignore_errors=True)
+            n += 1
+        mine = f'.tmp-{os.getpid()}'
+        for path in mf.stale_tmp_dirs(self.directory):
+            if not sweep_own and path.endswith(mine):
+                continue   # could be this process's own in-flight write
+            shutil.rmtree(path, ignore_errors=True)
+            n += 1
+        return n
+
+    # -- restore ----------------------------------------------------------
+
+    def restore_latest(self, apply: bool = True, strict: bool = True,
+                       restore_rng: bool = True):
+        """Restore the newest committed checkpoint that passes full hash
+        validation, falling back step by step on corruption.
+
+        Returns None when the directory holds no committed checkpoint;
+        raises CorruptCheckpointError when checkpoints exist but every
+        one fails validation. With ``apply=True`` (default) the restored
+        state is written into the bound ``params`` / ``trainer`` and the
+        RNG stream, and the step number is returned; with ``apply=False``
+        the raw ``RestoredCheckpoint`` is returned instead."""
+        self.wait()
+        steps = self.all_steps()
+        if not steps:
+            return None
+        for step in reversed(steps):
+            try:
+                return self.restore(step, apply=apply, strict=strict,
+                                    restore_rng=restore_rng)
+            except CorruptCheckpointError as e:
+                if _telem['on']:
+                    from .. import telemetry as _telemetry
+                    _telemetry.inc('mxnet_tpu_checkpoint_corrupt_total')
+                warnings.warn(
+                    f"checkpoint step {step} failed validation, falling "
+                    f"back to the previous committed step: {e}",
+                    RuntimeWarning)
+        raise CorruptCheckpointError(
+            f"no checkpoint under {self.directory} passed validation "
+            f"(tried steps {list(reversed(steps))})")
+
+    def restore(self, step: int, apply: bool = True, strict: bool = True,
+                restore_rng: bool = True):
+        """Restore one committed step (hash-verified). See restore_latest."""
+        t0 = _time.perf_counter()
+        ck = self._load_step(step)
+        if apply:
+            target = self._params
+            if target is not None:
+                _apply_params(target, ck.params, strict)
+            elif strict and ck.params:
+                raise MXNetError(
+                    "checkpoint restore: no params bound to this manager; "
+                    "construct with params=... or call with apply=False")
+            if self._trainer is not None and ck.trainer_states is not None:
+                self._trainer.set_states_bytes(ck.trainer_states)
+            if restore_rng and ck.rng:
+                from .. import random as _random
+                _random.set_state(ck.rng)
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.observe('mxnet_tpu_checkpoint_restore_seconds',
+                               _time.perf_counter() - t0)
+        return ck.step if apply else ck
+
+    def _load_step(self, step: int) -> RestoredCheckpoint:
+        """Single-pass read + hash-verify of one committed step dir."""
+        from ..serialization import load_ndarray_file
+        d = self.step_dir(step)
+        doc = mf.read_manifest(d)
+        if doc.get('step') != int(step):
+            raise CorruptCheckpointError(
+                f"{d}: manifest step {doc.get('step')} != dir step {step}")
+
+        def _read_verified(entry):
+            path = os.path.join(d, entry['file'])
+            try:
+                with open(path, 'rb') as f:
+                    data = f.read()
+            except OSError as e:
+                raise CorruptCheckpointError(f"{path}: {e}")
+            if len(data) != entry['bytes'] or \
+                    mf.sha256_bytes(data) != entry['sha256']:
+                raise CorruptCheckpointError(
+                    f"{path}: content hash mismatch")
+            return data
+
+        params = {}
+        for entry in doc.get('arrays', []):
+            arrays, names = load_ndarray_file(_read_verified(entry))
+            params[entry['name']] = arrays[0]
+        blobs = {entry['name']: _read_verified(entry)
+                 for entry in doc.get('blobs', [])}
+        return RestoredCheckpoint(doc['step'], d, params, blobs,
+                                  doc.get('metadata', {}), doc.get('rng'))
+
+    # -- preemption -------------------------------------------------------
+
+    def install_preemption_hook(self, signals=(_signal.SIGTERM,)) -> None:
+        """On each signal: synchronously commit a checkpoint at the
+        current step, set ``self.preempted`` and chain any previous python
+        handler. The training loop should poll ``preempted`` and exit."""
+        for sig in signals:
+            old = _signal.signal(sig, self._on_signal)
+            self._old_handlers.setdefault(sig, old)
+
+    def uninstall_preemption_hook(self) -> None:
+        for sig, old in self._old_handlers.items():
+            _signal.signal(sig, old if old is not None else _signal.SIG_DFL)
+        self._old_handlers.clear()
+
+    def _on_signal(self, signum, frame):
+        self.preempted = True
+        # _in_save: the signal interrupted the main thread INSIDE save()
+        # — re-entering would destroy that save's tmp dir mid-write; the
+        # interrupted save commits this step when the handler returns
+        if not self._in_save and not self._in_signal_save \
+                and self._current_step is not None:
+            self._in_signal_save = True
+            try:
+                # let an in-flight async write commit first: if it was
+                # already saving this step, a second full write would
+                # waste the preemption grace window
+                try:
+                    self.wait()
+                except MXNetError:
+                    pass   # the pending write failed — save fresh below
+                if self.latest_step() != self._current_step:
+                    self.save_now(self._current_step)
+            finally:
+                self._in_signal_save = False
+        old = self._old_handlers.get(signum)
+        if callable(old):
+            old(signum, frame)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush the in-flight write and unhook signals."""
+        self.wait()
+        self.uninstall_preemption_hook()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -190,7 +190,7 @@ class Block:
         """Writes the reference's binary .params format (ref: gluon/block.py
         save_parameters → ndarray.cc NDArray::Save) — loadable by the
         reference and vice versa."""
-        from ..serialization import save_ndarray_file
+        from ..serialization import atomic_write_file, save_ndarray_file
         params = self._collect_params_with_prefix()
         if deduplicate:
             # shared Parameter objects are stored once, under the first
@@ -206,8 +206,7 @@ class Block:
             params = uniq
         arg_dict = {key: val._reduce_np() if hasattr(val, '_reduce_np')
                     else val.data().asnumpy() for key, val in params.items()}
-        with open(filename, 'wb') as f:
-            f.write(save_ndarray_file(arg_dict))
+        atomic_write_file(filename, save_ndarray_file(arg_dict))
 
     def _collect_params_with_prefix(self, prefix=''):
         if prefix:
@@ -222,7 +221,9 @@ class Block:
                         dtype_source='current'):
         from ..serialization import load_params_dict
         with open(filename, 'rb') as f:
-            loaded = load_params_dict(f.read())
+            # allow_pickle: legacy round-1 .params files are still loadable
+            # (restricted numpy-only unpickler; warns once when hit)
+            loaded = load_params_dict(f.read(), allow_pickle=True)
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return

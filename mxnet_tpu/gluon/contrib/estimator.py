@@ -179,44 +179,80 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
         self.batch_index = 0
 
 
-class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
-    """Ref: event_handler.py CheckpointHandler."""
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Ref: event_handler.py CheckpointHandler — backed by
+    ``checkpoint.CheckpointManager``: atomic manifests, async background
+    writes, keep-last-``max_checkpoints`` retention, and optional
+    preemption-safe resume (``resume_from_checkpoint=True`` restores the
+    newest hash-verified checkpoint — params, optimizer state and RNG
+    stream — before training starts)."""
 
     def __init__(self, model_dir, model_prefix='model', monitor=None,
                  verbose=0, save_best=False, mode='auto', epoch_period=1,
                  batch_period=None, max_checkpoints=5,
                  resume_from_checkpoint=False):
         import os
+        if monitor is not None or save_best:
+            import warnings
+            warnings.warn(
+                "CheckpointHandler: monitor/save_best are not supported "
+                "by the manager-backed handler yet — checkpoints are "
+                "retained by recency (keep-last-max_checkpoints), not by "
+                "metric. These arguments are ignored.", RuntimeWarning,
+                stacklevel=2)
+        # checkpoints land in CheckpointManager step_* dirs under
+        # model_dir, not {model_prefix}-epochN.params files; model_prefix
+        # is retained for signature compatibility only
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.epoch_period = epoch_period
         self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.current_batch = 0
         self.current_epoch = 0
+        self.resumed_step = None
+        self._last_saved_step = None
+        self.manager = None
         os.makedirs(model_dir, exist_ok=True)
 
     def train_begin(self, estimator, *args, **kwargs):
+        from ... import checkpoint as _checkpoint
         self.current_batch = 0
         self.current_epoch = 0
+        self.manager = _checkpoint.CheckpointManager(
+            self.model_dir, params=estimator.net, trainer=estimator.trainer,
+            keep_last_n=max(1, self.max_checkpoints))
+        if self.resume_from_checkpoint:
+            self.resumed_step = self.manager.restore_latest()
+            if self.resumed_step is not None:
+                self.current_batch = self.resumed_step
+                logging.getLogger('estimator').info(
+                    'CheckpointHandler: resumed from step %d',
+                    self.resumed_step)
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
         if self.batch_period and self.current_batch % self.batch_period == 0:
-            self._save(estimator)
+            self._save(metadata={'epoch': self.current_epoch})
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
         if self.epoch_period and self.current_epoch % self.epoch_period == 0:
-            self._save(estimator)
+            self._save(metadata={'epoch': self.current_epoch})
 
-    def _save(self, estimator):
-        import os
-        prefix = os.path.join(self.model_dir, self.model_prefix)
-        estimator.net.save_parameters(
-            f'{prefix}-epoch{self.current_epoch}.params')
-        if estimator.trainer is not None:
-            estimator.trainer.save_states(
-                f'{prefix}-epoch{self.current_epoch}.states')
+    def _save(self, metadata):
+        # batch_period dividing the epoch's batch count makes epoch_end
+        # land on the step batch_end just wrote — skip the duplicate
+        # full serialize/hash/commit of a byte-identical checkpoint
+        if self._last_saved_step == self.current_batch:
+            return
+        self._last_saved_step = self.current_batch
+        self.manager.save(self.current_batch, metadata=metadata)
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.manager is not None:
+            self.manager.close()
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

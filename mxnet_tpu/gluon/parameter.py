@@ -400,7 +400,7 @@ class ParameterDict:
 
     def save(self, filename, strip_prefix=''):
         """Reference binary .params container (ndarray.cc NDArray::Save)."""
-        from ..serialization import save_ndarray_file
+        from ..serialization import atomic_write_file, save_ndarray_file
         arg_dict = {}
         for p in self.values():
             if p._data is None:
@@ -411,14 +411,14 @@ class ParameterDict:
             if name.startswith(strip_prefix):
                 name = name[len(strip_prefix):]
             arg_dict[name] = p.data().asnumpy()
-        with open(filename, 'wb') as f:
-            f.write(save_ndarray_file(arg_dict))
+        atomic_write_file(filename, save_ndarray_file(arg_dict))
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=''):
         from ..serialization import load_params_dict
         with open(filename, 'rb') as f:
-            arg_dict = load_params_dict(f.read())
+            # allow_pickle: legacy round-1 files (restricted unpickler)
+            arg_dict = load_params_dict(f.read(), allow_pickle=True)
         if restore_prefix:
             arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
         for name, p in self.items():

@@ -401,20 +401,21 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def save_states(self, fname):
-        """Ref: trainer.py:463."""
+    def get_states_bytes(self):
+        """The save_states payload as bytes: optimizer states + the
+        pickled optimizer itself (update counts, rescale_grad, schedule
+        position). This is what checkpoint.CheckpointManager snapshots on
+        the training thread for an async save."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, 'wb') as f:
-            f.write(self._updater.get_states(dump_optimizer=True))
+        return self._updater.get_states(dump_optimizer=True)
 
-    def load_states(self, fname):
-        """Ref: trainer.py:492."""
+    def set_states_bytes(self, states):
+        """Restore a get_states_bytes() payload (CheckpointManager's
+        restore path; load_states is the file-based wrapper)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, 'rb') as f:
-            states = f.read()
         self._updater.set_states(states)
         if hasattr(self._updater, 'optimizer'):
             self._optimizer = self._updater.optimizer
@@ -422,3 +423,19 @@ class Trainer:
             # per-parameter lr_mult/wd_mult must be rebound after restore
             self._optimizer.param_dict = {
                 i: p for i, p in enumerate(self._params)}
+        # the restored optimizer replaces the one the fused-update trace
+        # closed over — force a retrace against the new instance
+        self._fused_cache = None
+        self._fused_traced = False
+
+    def save_states(self, fname):
+        """Ref: trainer.py:463. Atomic: tmp file + os.replace, so a kill
+        mid-write never corrupts the previous states file."""
+        from ..serialization import atomic_write_file
+        atomic_write_file(fname, self.get_states_bytes())
+
+    def load_states(self, fname):
+        """Ref: trainer.py:492."""
+        with open(fname, 'rb') as f:
+            states = f.read()
+        self.set_states_bytes(states)

@@ -10,13 +10,13 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     """Ref: model.py save_checkpoint — writes prefix-symbol.json and
     prefix-XXXX.params in the reference binary format (arg:/aux: keyed,
     ndarray.cc NDArray::Save container)."""
-    from .serialization import save_ndarray_file
+    from .serialization import atomic_write_file, save_ndarray_file
     if symbol is not None:
         symbol.save(f'{prefix}-symbol.json')
     payload = {f'arg:{k}': v.asnumpy() for k, v in arg_params.items()}
     payload.update({f'aux:{k}': v.asnumpy() for k, v in aux_params.items()})
-    with open(f'{prefix}-{epoch:04d}.params', 'wb') as f:
-        f.write(save_ndarray_file(payload))
+    atomic_write_file(f'{prefix}-{epoch:04d}.params',
+                      save_ndarray_file(payload))
 
 
 def load_checkpoint(prefix, epoch):
@@ -25,7 +25,9 @@ def load_checkpoint(prefix, epoch):
     from .serialization import load_params_dict
     symbol = sym_mod.load(f'{prefix}-symbol.json')
     with open(f'{prefix}-{epoch:04d}.params', 'rb') as f:
-        payload = load_params_dict(f.read(), strip_arg_aux=False)
+        # allow_pickle: legacy round-1 files (restricted unpickler)
+        payload = load_params_dict(f.read(), allow_pickle=True,
+                                   strip_arg_aux=False)
     arg_params = {}
     aux_params = {}
     for k, v in payload.items():

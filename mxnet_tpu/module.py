@@ -397,8 +397,9 @@ class Module(BaseModule):
         arg_params, aux_params = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
         if save_optimizer_states:
-            with open(f'{prefix}-{epoch:04d}.states', 'wb') as f:
-                f.write(self._updater.get_states())
+            from .serialization import atomic_write_file
+            atomic_write_file(f'{prefix}-{epoch:04d}.states',
+                              self._updater.get_states())
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

@@ -622,7 +622,7 @@ def save(fname, data):
     """Writes the reference's dmlc binary container (ref:
     src/ndarray/ndarray.cc NDArray::Save, kMXAPINDArrayListMagic) so files
     interchange with the reference ecosystem."""
-    from ..serialization import save_ndarray_file
+    from ..serialization import atomic_write_file, save_ndarray_file
     if isinstance(data, NDArray):
         payload = [data.asnumpy()]
     elif isinstance(data, (list, tuple)):
@@ -633,8 +633,7 @@ def save(fname, data):
         payload = {k: v.asnumpy() for k, v in data.items()}
     else:
         raise MXNetError("save expects NDArray, list, or dict")
-    with open(fname, 'wb') as f:
-        f.write(save_ndarray_file(payload))
+    atomic_write_file(fname, save_ndarray_file(payload))
 
 
 def _decode_loaded(entry):

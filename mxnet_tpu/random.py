@@ -100,3 +100,34 @@ def seed(seed_state: int, ctx=None):
     """Seed the global generator (ref: python/mxnet/random.py seed)."""
     _global_provider.seed(int(seed_state))
     _onp.random.seed(int(seed_state) % (2 ** 31))
+
+
+def get_state() -> dict:
+    """JSON-serializable snapshot of the global RNG stream — the key
+    provider's (seed, counter) plus the global numpy generator state —
+    so a restored checkpoint resumes the exact random stream
+    (checkpoint.CheckpointManager stores this in the manifest)."""
+    with _global_provider._lock:
+        st = {'seed': _global_provider._seed_val,
+              'counter': _global_provider._counter}
+    kind, keys, pos, has_gauss, cached = _onp.random.get_state()
+    st['numpy'] = {'kind': kind, 'keys': [int(k) for k in keys],
+                   'pos': int(pos), 'has_gauss': int(has_gauss),
+                   'cached_gaussian': float(cached)}
+    return st
+
+
+def set_state(state: dict) -> None:
+    """Restore a get_state() snapshot (counter-based, so the base key is
+    rebuilt lazily exactly as after the original seed())."""
+    with _global_provider._lock:
+        _global_provider._seed_val = int(state['seed'])
+        _global_provider._counter = int(state['counter'])
+        _global_provider._base = None
+    np_st = state.get('numpy')
+    if np_st:
+        _onp.random.set_state((
+            np_st['kind'],
+            _onp.asarray(np_st['keys'], dtype=_onp.uint32),
+            int(np_st['pos']), int(np_st['has_gauss']),
+            float(np_st['cached_gaussian'])))

@@ -242,16 +242,30 @@ def is_ndarray_file(buf: bytes) -> bool:
     return len(buf) >= 8 and struct.unpack('<Q', buf[:8])[0] == LIST_MAGIC
 
 
-def load_params_dict(buf: bytes, allow_pickle: bool = True,
+def atomic_write_file(path: str, data: bytes) -> None:
+    """Crash-safe single-file write: tmp file in the same directory,
+    fsync, then one ``os.replace`` — a kill mid-write leaves the previous
+    file contents (or no file), never a truncated hybrid. Every .params /
+    .states / .ndarray writer in the tree routes through this."""
+    from .checkpoint.manifest import atomic_write_bytes
+    atomic_write_bytes(path, data)
+
+
+_pickle_fallback_warned = False
+
+
+def load_params_dict(buf: bytes, allow_pickle: bool = False,
                      strip_arg_aux: bool = True):
     """Parse a .params blob into {name: dense numpy array}.
 
     The single decode path used by Block.load_parameters,
     ParameterDict.load, model.load_checkpoint, ndarray.load and the C
-    predict ABI: binary container first; optionally a restricted
-    (numpy-only) unpickle fallback for round-1 files. Sparse entries are
-    densified; reference save_checkpoint-style 'arg:'/'aux:' prefixes are
-    stripped when every key carries one."""
+    predict ABI: binary container first. The restricted (numpy-only)
+    unpickle fallback for round-1 files is OFF by default — the callers
+    that still accept legacy files opt in with ``allow_pickle=True`` and
+    a one-time warning fires when the fallback actually triggers. Sparse
+    entries are densified; reference save_checkpoint-style 'arg:'/'aux:'
+    prefixes are stripped when every key carries one."""
     if is_ndarray_file(buf):
         arrays, names = load_ndarray_file(buf)
         out = {}
@@ -262,6 +276,16 @@ def load_params_dict(buf: bytes, allow_pickle: bool = True,
                 raise FormatError(f"entry '{k}' is a none-array")
             out[k] = v
     elif allow_pickle:
+        global _pickle_fallback_warned
+        if not _pickle_fallback_warned:
+            _pickle_fallback_warned = True
+            import warnings
+            warnings.warn(
+                "params blob is not a reference-format NDArray file; "
+                "falling back to the restricted (numpy-only) unpickler "
+                "for a legacy round-1 file. Re-save with the current "
+                "writer to drop the pickle dependency.", RuntimeWarning,
+                stacklevel=2)
         loaded = safe_pickle_load(io.BytesIO(buf))
         # round-1 wrote either a bare dict or a ('dict', payload) pair
         if isinstance(loaded, tuple) and len(loaded) == 2 \

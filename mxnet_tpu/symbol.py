@@ -286,8 +286,8 @@ class Symbol:
                            'mxnet_tpu_version': 2}, indent=2)
 
     def save(self, fname):
-        with open(fname, 'w') as f:
-            f.write(self.tojson())
+        from .serialization import atomic_write_file
+        atomic_write_file(fname, self.tojson().encode('utf-8'))
 
     def __repr__(self):
         return f"<Symbol {self._name}>"

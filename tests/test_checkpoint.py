@@ -1,0 +1,554 @@
+"""Fault-tolerant async checkpointing (mxnet_tpu/checkpoint/).
+
+Covers the crash-consistency contract (kill -9 between array write and
+manifest commit leaves restore_latest() returning the previous step's
+bit-identical, hash-verified params), the async overlap telemetry
+(blocked < save), retention GC, preemption signal hook, trainer states
+round-trip invariants, and the CLI manifest validator."""
+import glob
+import os
+import signal
+import subprocess
+import sys
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, nd, telemetry
+from mxnet_tpu.checkpoint import (CheckpointManager, CorruptCheckpointError,
+                                  validate_step_dir)
+from mxnet_tpu.checkpoint.manager import _TEST_HOOKS
+from mxnet_tpu.gluon import Trainer, nn
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _make_net_and_trainer(momentum=0.9, rescale_grad=1.0):
+    net = nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), 'sgd',
+                      {'learning_rate': 0.1, 'momentum': momentum,
+                       'rescale_grad': rescale_grad})
+    return net, trainer
+
+
+def _train_steps(net, trainer, n=2, batch=2):
+    x = nd.array(onp.random.RandomState(0).rand(batch, 3)
+                 .astype(onp.float32))
+    for _ in range(n):
+        with mx.autograd.record():
+            y = (net(x) ** 2).sum()
+        y.backward()
+        trainer.step(batch)
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    yield
+    _TEST_HOOKS.clear()
+
+
+# ---------------------------------------------------------------------------
+# round-trip
+# ---------------------------------------------------------------------------
+
+def test_save_restore_roundtrip_bit_identical(tmp_path):
+    net, trainer = _make_net_and_trainer()
+    _train_steps(net, trainer)
+    mgr = CheckpointManager(str(tmp_path), params=net, trainer=trainer)
+    mgr.save(7, block=True)
+    w = net.weight.data().asnumpy().copy()
+    b = net.bias.data().asnumpy().copy()
+    counts = dict(trainer.optimizer._index_update_count)
+    mx.random.seed(123)   # perturb RNG stream too
+    net.weight.set_data(nd.zeros((4, 3)))
+    net.bias.set_data(nd.ones((4,)))
+    assert mgr.restore_latest() == 7
+    onp.testing.assert_array_equal(net.weight.data().asnumpy(), w)
+    onp.testing.assert_array_equal(net.bias.data().asnumpy(), b)
+    assert dict(trainer.optimizer._index_update_count) == counts
+    mgr.close()
+
+
+def test_restore_rng_stream_resumes(tmp_path):
+    mx.random.seed(42)
+    mx.nd.random.uniform(shape=(2,)).asnumpy()     # advance the stream
+    mgr = CheckpointManager(str(tmp_path), params={})
+    mgr.save(1, block=True)
+    expected = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(999)                            # diverge
+    assert mgr.restore_latest() == 1
+    resumed = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    onp.testing.assert_array_equal(resumed, expected)
+    mgr.close()
+
+
+def test_restore_latest_empty_dir_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), params={})
+    assert mgr.restore_latest() is None
+    mgr.close()
+
+
+def test_restore_apply_false_returns_payload(tmp_path):
+    arrs = {'w': nd.array(onp.arange(6).reshape(2, 3)
+                          .astype(onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs)
+    mgr.save(3, metadata={'note': 'hello'}, block=True)
+    ck = mgr.restore_latest(apply=False)
+    assert ck.step == 3
+    assert ck.metadata == {'note': 'hello'}
+    onp.testing.assert_array_equal(ck.params['w'],
+                                   arrs['w'].asnumpy())
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# trainer states invariants (gluon/trainer.py:282-310 contract)
+# ---------------------------------------------------------------------------
+
+def test_trainer_states_file_roundtrip(tmp_path):
+    net, trainer = _make_net_and_trainer(rescale_grad=2.0)
+    _train_steps(net, trainer, n=3, batch=2)
+    counts = dict(trainer.optimizer._index_update_count)
+    num_update = trainer.optimizer.num_update
+    rescale = trainer.optimizer.rescale_grad
+    assert counts, "training must have counted updates"
+    f = str(tmp_path / 'trainer.states')
+    trainer.save_states(f)
+
+    net2, trainer2 = _make_net_and_trainer(momentum=0.0, rescale_grad=1.0)
+    trainer2.load_states(f)
+    assert dict(trainer2.optimizer._index_update_count) == counts
+    assert trainer2.optimizer.num_update == num_update
+    assert trainer2.optimizer.rescale_grad == rescale
+    # momentum states restored as NDArrays keyed by param index
+    st = trainer2._updater.states
+    assert set(st) == set(trainer._updater.states)
+    # restored optimizer re-binds the live params for lr_mult/wd_mult
+    assert trainer2.optimizer.param_dict[0] is trainer2._params[0]
+
+
+def test_trainer_states_atomic_write_keeps_previous_on_failure(tmp_path):
+    net, trainer = _make_net_and_trainer()
+    _train_steps(net, trainer)
+    f = str(tmp_path / 'trainer.states')
+    trainer.save_states(f)
+    before = open(f, 'rb').read()
+    real_replace = os.replace
+
+    def boom(src, dst):
+        if dst == f:
+            raise OSError("disk gone")
+        return real_replace(src, dst)
+    os.replace = boom
+    try:
+        with pytest.raises(OSError):
+            trainer.save_states(f)
+    finally:
+        os.replace = real_replace
+    assert open(f, 'rb').read() == before
+    assert glob.glob(str(tmp_path / '*.tmp-*')) == []
+
+
+# ---------------------------------------------------------------------------
+# atomicity / crash consistency
+# ---------------------------------------------------------------------------
+
+_KILL9_SCRIPT = r"""
+import os, signal, sys
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.manager import _TEST_HOOKS
+
+root = sys.argv[1]
+params = {'w': mx.nd.array(onp.arange(12).reshape(3, 4).astype(onp.float32)),
+          'b': mx.nd.array(onp.full((4,), 7.0, onp.float32))}
+mgr = CheckpointManager(root, params=params)
+mgr.save(1, block=True)                      # the checkpoint that must survive
+params['w'] += 100                           # step-2 state differs
+_TEST_HOOKS['before_commit'] = \
+    lambda path: os.kill(os.getpid(), signal.SIGKILL)
+mgr.save(2, block=True)                      # dies between arrays and commit
+print('UNREACHABLE')
+"""
+
+
+def test_kill9_between_write_and_commit_preserves_previous_step(tmp_path):
+    """Acceptance: kill -9 between array write and manifest commit leaves
+    restore_latest() returning the previous step's bit-identical params
+    (hash-verified)."""
+    root = str(tmp_path / 'ckpt')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    res = subprocess.run([sys.executable, '-c', _KILL9_SCRIPT, root],
+                         capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=600)
+    assert res.returncode == -signal.SIGKILL, (res.returncode, res.stderr)
+    assert 'UNREACHABLE' not in res.stdout
+    # the killed write left only an uncommitted tmp dir
+    assert [os.path.basename(p) for p in
+            glob.glob(os.path.join(root, 'step_*')) if '.tmp-' not in p] \
+        == ['step_0000000001']
+    assert glob.glob(os.path.join(root, '*.tmp-*')), \
+        "expected the torn step-2 write to remain as a tmp dir"
+    # restore: hash-verified, bit-identical step-1 params
+    mgr = CheckpointManager(root, params=None)
+    ck = mgr.restore_latest(apply=False)
+    assert ck.step == 1
+    onp.testing.assert_array_equal(
+        ck.params['w'],
+        onp.arange(12).reshape(3, 4).astype(onp.float32))
+    onp.testing.assert_array_equal(
+        ck.params['b'], onp.full((4,), 7.0, onp.float32))
+    # the fresh manager swept the dead writer's tmp dir
+    assert glob.glob(os.path.join(root, '*.tmp-*')) == []
+    mgr.close()
+
+
+def test_corrupt_latest_falls_back_to_previous(tmp_path):
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        arrs = {'w': nd.array(onp.eye(3, dtype=onp.float32))}
+        mgr = CheckpointManager(str(tmp_path), params=arrs)
+        mgr.save(1, block=True)
+        arrs['w'] += 1
+        mgr.save(2, block=True)
+        # flip bytes inside step 2's array payload
+        f = glob.glob(str(tmp_path / 'step_0000000002' / 'arrays' / '*'))[0]
+        with open(f, 'r+b') as fh:
+            fh.seek(os.path.getsize(f) - 4)
+            fh.write(b'\xde\xad\xbe\xef')
+        with pytest.warns(RuntimeWarning, match='falling back'):
+            ck = mgr.restore_latest(apply=False)
+        assert ck.step == 1
+        onp.testing.assert_array_equal(ck.params['w'],
+                                       onp.eye(3, dtype=onp.float32))
+        assert telemetry.value('mxnet_tpu_checkpoint_corrupt_total') == 1
+        mgr.close()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_all_corrupt_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path),
+                            params={'w': nd.ones((2, 2))})
+    mgr.save(1, block=True)
+    os.unlink(glob.glob(str(tmp_path / 'step_0000000001' / 'arrays'
+                            / '*'))[0])
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        with pytest.raises(CorruptCheckpointError):
+            mgr.restore_latest()
+    mgr.close()
+
+
+def test_validate_step_dir_reports_all_problems(tmp_path):
+    mgr = CheckpointManager(str(tmp_path),
+                            params={'w': nd.ones((2, 2)),
+                                    'b': nd.zeros((2,))})
+    mgr.save(5, block=True)
+    d = str(tmp_path / 'step_0000000005')
+    validate_step_dir(d)                     # clean passes
+    files = sorted(glob.glob(os.path.join(d, 'arrays', '*')))
+    os.unlink(files[0])
+    with open(files[1], 'ab') as fh:
+        fh.write(b'junk')
+    with pytest.raises(CorruptCheckpointError) as ei:
+        validate_step_dir(d)
+    msg = str(ei.value)
+    assert 'missing' in msg and 'size' in msg    # both named, not just first
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# async overlap (acceptance: blocked < save in telemetry)
+# ---------------------------------------------------------------------------
+
+def test_async_save_blocked_time_less_than_save_time(tmp_path):
+    import time
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        _TEST_HOOKS['during_write'] = lambda path: time.sleep(0.02)
+        arrs = {f'p{i}': nd.array(onp.random.RandomState(i)
+                                  .rand(32, 32).astype(onp.float32))
+                for i in range(5)}
+        mgr = CheckpointManager(str(tmp_path), params=arrs, async_save=True)
+        mgr.save(1)                      # returns after snapshot only
+        overlapped = 0.0
+        t0 = time.perf_counter()
+        while mgr._pending is not None and mgr._pending.is_alive():
+            overlapped = time.perf_counter() - t0   # "training" continues
+        mgr.wait()
+        n_blk, blocked = telemetry.value(
+            'mxnet_tpu_checkpoint_blocked_seconds')
+        n_sav, saved = telemetry.value('mxnet_tpu_checkpoint_save_seconds')
+        assert n_blk == 1 and n_sav == 1
+        assert blocked < saved, (blocked, saved)
+        assert saved >= 5 * 0.02          # write really was slowed
+        assert telemetry.value('mxnet_tpu_checkpoint_saves_total') == 1
+        assert telemetry.value('mxnet_tpu_checkpoint_last_step') == 1
+        assert telemetry.value('mxnet_tpu_checkpoint_bytes') > 0
+        assert overlapped > 0             # caller observed the write in flight
+        assert mgr.restore_latest(apply=False).step == 1
+        mgr.close()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_background_write_error_surfaces_on_next_call(tmp_path):
+    def boom(path):
+        raise RuntimeError("injected write failure")
+    _TEST_HOOKS['after_arrays'] = boom
+    mgr = CheckpointManager(str(tmp_path), params={'w': nd.ones((2,))})
+    mgr.save(1)
+    with pytest.raises(mx.MXNetError, match='injected write failure'):
+        mgr.wait()
+    _TEST_HOOKS.clear()
+    mgr.save(2, block=True)              # manager still usable afterwards
+    assert mgr.all_steps() == [2]
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# retention / GC
+# ---------------------------------------------------------------------------
+
+def test_retention_keep_last_n_and_every_k(tmp_path):
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        arrs = {'w': nd.ones((2, 2))}
+        mgr = CheckpointManager(str(tmp_path), params=arrs,
+                                keep_last_n=2, keep_every_k_steps=10,
+                                async_save=False)
+        for s in range(1, 13):
+            mgr.save(s)
+        # keep-last-2 = {11, 12}; keep-every-10 = {10}
+        assert mgr.all_steps() == [10, 11, 12]
+        assert telemetry.value('mxnet_tpu_checkpoint_gc_total') == 9
+        mgr.close()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_autosave_steps_cadence(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), params={'w': nd.ones((2,))},
+                            autosave_steps=3, async_save=False)
+    saved = [s for s in range(1, 8) if mgr.maybe_save(s)]
+    assert saved == [3, 6]
+    assert mgr.all_steps() == [3, 6]
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# preemption hook
+# ---------------------------------------------------------------------------
+
+def test_sigterm_hook_saves_current_step_and_sets_preempted(tmp_path):
+    arrs = {'w': nd.array(onp.full((2, 2), 3.0, onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs)
+    prev_calls = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: prev_calls.append(s))
+    try:
+        mgr.install_preemption_hook()
+        mgr.maybe_save(41)                    # records the current step
+        assert mgr.all_steps() == []          # no cadence -> nothing saved
+        signal.raise_signal(signal.SIGTERM)
+        assert mgr.preempted
+        assert mgr.all_steps() == [41]        # committed synchronously
+        assert prev_calls == [signal.SIGTERM]  # prior handler chained
+        ck = mgr.restore_latest(apply=False)
+        onp.testing.assert_array_equal(ck.params['w'],
+                                       onp.full((2, 2), 3.0, onp.float32))
+        mgr.close()
+        assert signal.getsignal(signal.SIGTERM) is not mgr._on_signal
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# integrations: estimator handler + legacy callbacks
+# ---------------------------------------------------------------------------
+
+def _fit_once(model_dir, resume):
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+    from mxnet_tpu.gluon import loss as gloss
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gloss.L2Loss(), context=[mx.cpu()])
+    handler = CheckpointHandler(model_dir, resume_from_checkpoint=resume)
+    rng = onp.random.RandomState(0)
+    data = [(nd.array(rng.rand(4, 3).astype(onp.float32)),
+             nd.array(rng.rand(4, 2).astype(onp.float32)))]
+    est.fit(train_data=data, epochs=2, event_handlers=[handler])
+    return net, handler
+
+
+def test_estimator_checkpoint_handler_saves_and_resumes(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+    from mxnet_tpu.gluon import loss as gloss
+    d = str(tmp_path / 'est')
+    net1, h1 = _fit_once(d, resume=False)
+    steps = h1.manager.all_steps()
+    assert steps, "CheckpointHandler must commit at least one checkpoint"
+    w1 = net1.weight.data().asnumpy().copy()
+    # resume: train_begin must restore the committed weights into a fresh
+    # net, not just report the step number
+    net2 = nn.Dense(2, in_units=3)
+    net2.initialize(mx.init.Xavier())
+    assert not onp.array_equal(net2.weight.data().asnumpy(), w1)
+    est2 = Estimator(net2, loss=gloss.L2Loss(), context=[mx.cpu()])
+    h2 = CheckpointHandler(d, resume_from_checkpoint=True)
+    h2.train_begin(est2)
+    assert h2.resumed_step == steps[-1]
+    onp.testing.assert_array_equal(net2.weight.data().asnumpy(), w1)
+    h2.manager.close()
+
+
+def test_checkpoint_handler_warns_on_unsupported_save_best(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+    with pytest.warns(RuntimeWarning, match='save_best'):
+        CheckpointHandler(str(tmp_path), save_best=True)
+
+
+def test_do_checkpoint_callback_routes_through_manager(tmp_path):
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.callback import do_checkpoint
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    cb = do_checkpoint('unused-prefix', period=2, manager=mgr)
+    net_sym = sym.fully_connected(sym.var('data'), num_hidden=2, name='fc')
+    arg = {'fc_weight': nd.ones((2, 3))}
+    aux = {'bn_mean': nd.zeros((3,))}
+    cb(0, net_sym, arg, aux)              # epoch 1: period not hit
+    assert mgr.all_steps() == []
+    cb(1, net_sym, arg, aux)              # epoch 2: saved through manager
+    assert mgr.all_steps() == [2]
+    ck = mgr.restore_latest(apply=False)
+    assert set(ck.params) == {'arg:fc_weight', 'aux:bn_mean'}
+    # the symbol rides along, so the checkpoint alone rebuilds the net
+    assert ck.blobs['symbol'] == net_sym.tojson().encode('utf-8')
+    assert glob.glob(str(tmp_path / 'unused-prefix*')) == []
+    mgr.close()
+
+
+def test_resave_same_step_failure_rolls_back_in_live_manager(tmp_path):
+    """A re-save of an already-committed step that fails after retiring
+    the committed copy aside must roll the copy back immediately — the
+    LIVE manager keeps seeing the step, with the original contents."""
+    arrs = {'w': nd.array(onp.full((2, 2), 1.0, onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs, async_save=False)
+    mgr.save(3)
+
+    def die(path):
+        raise RuntimeError('disk full mid-swap')
+    _TEST_HOOKS['after_retire_old'] = die
+    arrs['w'] += 9                        # the re-save that will fail
+    with pytest.raises(mx.MXNetError, match='write failed'):
+        mgr.save(3)                       # sync mode: surfaces immediately
+    _TEST_HOOKS.clear()
+    assert mgr.all_steps() == [3]         # rolled back, still visible
+    ck = mgr.restore_latest(apply=False)
+    onp.testing.assert_array_equal(ck.params['w'],
+                                   onp.full((2, 2), 1.0, onp.float32))
+    assert glob.glob(str(tmp_path / '*.old-*')) == []
+    assert glob.glob(str(tmp_path / '*.tmp-*')) == []
+    mgr.close()
+
+
+def test_midswap_kill_recovered_by_next_manager(tmp_path):
+    """Same mid-swap crash but the PROCESS dies (no in-process rollback):
+    the next manager's startup recovery renames the retired copy back."""
+    mgr = CheckpointManager(str(tmp_path),
+                            params={'w': nd.ones((2, 2))},
+                            async_save=False)
+    mgr.save(4)
+    mgr.close()
+    final = str(tmp_path / 'step_0000000004')
+    os.replace(final, final + '.old-99999')   # the on-disk mid-swap state
+    assert checkpoint.committed_steps(str(tmp_path)) == []
+    mgr2 = CheckpointManager(str(tmp_path), params=None)
+    assert mgr2.all_steps() == [4]            # recovered at startup
+    assert mgr2.restore_latest(apply=False).step == 4
+    assert glob.glob(str(tmp_path / '*.old-*')) == []
+    mgr2.close()
+
+
+def test_plain_numpy_params_are_copied_not_aliased(tmp_path):
+    """An async save of a plain-numpy params dict must snapshot by copy:
+    mutating the array after save() returns must not tear the write."""
+    import time
+    w = onp.full((8, 8), 1.0, onp.float32)
+    mgr = CheckpointManager(str(tmp_path), params={'w': w})
+    _TEST_HOOKS['during_write'] = lambda path: time.sleep(0.05)
+    mgr.save(1)                           # snapshot taken here
+    w += 41.0                             # training mutates in place
+    mgr.wait()
+    _TEST_HOOKS.clear()
+    ck = mgr.restore_latest(apply=False)
+    onp.testing.assert_array_equal(ck.params['w'],
+                                   onp.full((8, 8), 1.0, onp.float32))
+    mgr.close()
+
+
+def test_sigterm_during_save_does_not_destroy_inflight_write(tmp_path):
+    """A SIGTERM landing while the main thread is inside save() must not
+    re-enter the writer (which would delete the in-flight tmp dir); the
+    interrupted save itself commits the current step."""
+    arrs = {'w': nd.array(onp.full((2, 2), 5.0, onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs, async_save=False)
+    prev = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    try:
+        mgr.install_preemption_hook()
+        _TEST_HOOKS['during_write'] = \
+            lambda path: signal.raise_signal(signal.SIGTERM)
+        mgr.save(9)
+        assert mgr.preempted
+        assert mgr.all_steps() == [9]
+        ck = mgr.restore_latest(apply=False)
+        onp.testing.assert_array_equal(ck.params['w'],
+                                       onp.full((2, 2), 5.0, onp.float32))
+        mgr.close()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# CLI tool (runs standalone, no framework import)
+# ---------------------------------------------------------------------------
+
+def test_manifest_cli_tool_ok_and_corrupt(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), params={'w': nd.ones((3, 3))},
+                            async_save=False)
+    mgr.save(1)
+    mgr.save(2)
+    mgr.close()
+    tool = os.path.join(REPO, 'tools', 'check_checkpoint_manifest.py')
+    res = subprocess.run([sys.executable, tool, str(tmp_path)],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.count('OK') == 2
+    # per-step-dir invocation works too
+    res = subprocess.run(
+        [sys.executable, tool, str(tmp_path / 'step_0000000002')],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    # corrupt one payload -> exit 1 and the bad step named on stderr
+    f = glob.glob(str(tmp_path / 'step_0000000002' / 'arrays' / '*'))[0]
+    with open(f, 'r+b') as fh:
+        fh.write(b'\x00\x00\x00\x00')
+    res = subprocess.run([sys.executable, tool, str(tmp_path)],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1
+    assert 'step_0000000002' in res.stderr
+    res = subprocess.run([sys.executable, tool, str(tmp_path), '--step', '1'],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Validate a checkpoint directory's manifests and content hashes.
+
+Usage::
+
+    python tools/check_checkpoint_manifest.py CKPT_DIR [--step N] [--latest]
+
+``CKPT_DIR`` is either a checkpoint root (holding ``step_*`` dirs — every
+committed step is validated, or just one with ``--step``/``--latest``) or
+a single committed step dir (holding ``manifest.json``). Every payload
+file is re-hashed against the manifest's sha256 and byte counts; stale
+``*.tmp-*`` dirs are reported (informational — they are crash leftovers
+the next CheckpointManager sweeps, never valid restore targets).
+
+Exit code 0 when every validated step is intact, 1 otherwise. Runs
+standalone: loads ``mxnet_tpu/checkpoint/manifest.py`` by file path, so
+no framework (or jax) import is needed — usable on a storage host.
+Wired into the tier-1 pass via tests/test_checkpoint.py.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+
+def _load_manifest_module():
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), 'mxnet_tpu', 'checkpoint',
+                        'manifest.py')
+    spec = importlib.util.spec_from_file_location('_ckpt_manifest', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Validate checkpoint manifests/hashes.')
+    ap.add_argument('path', help='checkpoint root or one step_* dir')
+    ap.add_argument('--step', type=int, default=None,
+                    help='validate only this step')
+    ap.add_argument('--latest', action='store_true',
+                    help='validate only the newest committed step')
+    args = ap.parse_args(argv)
+    mf = _load_manifest_module()
+
+    path = os.path.abspath(args.path)
+    if not os.path.isdir(path):
+        print(f"{path}: not a directory", file=sys.stderr)
+        return 1
+
+    if os.path.isfile(os.path.join(path, mf.MANIFEST_NAME)):
+        targets = [path]
+    else:
+        steps = mf.committed_steps(path)
+        if args.step is not None:
+            if args.step not in steps:
+                print(f"{path}: no committed step {args.step} "
+                      f"(have {steps})", file=sys.stderr)
+                return 1
+            steps = [args.step]
+        elif args.latest:
+            if not steps:
+                print(f"{path}: no committed steps", file=sys.stderr)
+                return 1
+            steps = steps[-1:]
+        elif not steps:
+            print(f"{path}: no committed steps and no "
+                  f"{mf.MANIFEST_NAME}", file=sys.stderr)
+            return 1
+        targets = [os.path.join(path, mf.step_dir_name(s)) for s in steps]
+        for tmp in mf.stale_tmp_dirs(path):
+            print(f"note: stale uncommitted write {tmp} (crash leftover; "
+                  f"ignored by restore, swept by the next manager)")
+        for old, final in mf.stale_old_dirs(path):
+            state = 'recovery source — final copy missing, the next ' \
+                'manager rolls it back' if not os.path.isdir(final) \
+                else 'superseded copy, swept by the next manager'
+            print(f"note: retired re-save copy {old} ({state})")
+
+    failures = 0
+    for t in targets:
+        try:
+            doc = mf.validate_step_dir(t)
+        except Exception as e:  # noqa: BLE001 - report and keep scanning
+            print(f"FAIL {t}: {e}", file=sys.stderr)
+            failures += 1
+            continue
+        n_arr = len(doc.get('arrays', []))
+        n_blob = len(doc.get('blobs', []))
+        print(f"OK   {t}: step {doc.get('step')}, {n_arr} arrays, "
+              f"{n_blob} blobs, {doc.get('total_bytes', '?')} bytes, "
+              f"all sha256 verified")
+    return 1 if failures else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -34,6 +34,24 @@ CALL_RE = re.compile(
     r"\b(inc|set_gauge|observe|counter|gauge|histogram|value)\(\s*"
     r"'([^']+)'", re.S)
 
+# Subsystem contracts: metric sets that dashboards/docs (README,
+# PERF_NOTES) reference by name, with their kinds. The lint fails when
+# an instrumentation site drops/renames one of these, or adds a new
+# metric under the subsystem prefix without declaring it here — keeping
+# code, docs and dashboards from drifting apart silently.
+SUBSYSTEM_METRICS = {
+    'mxnet_tpu_checkpoint_': {
+        'mxnet_tpu_checkpoint_save_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_restore_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_bytes': 'gauge',
+        'mxnet_tpu_checkpoint_last_step': 'gauge',
+        'mxnet_tpu_checkpoint_saves_total': 'counter',
+        'mxnet_tpu_checkpoint_gc_total': 'counter',
+        'mxnet_tpu_checkpoint_corrupt_total': 'counter',
+    },
+}
+
 
 def scan(pkg_dir):
     """{name: {kind, ...}} plus [(path, lineno, name, problem), ...]."""
@@ -62,6 +80,24 @@ def scan(pkg_dir):
             errors.append(
                 ('<registry>', 0, name,
                  f"registered under multiple kinds: {sorted(kinds)}"))
+    for prefix, declared in SUBSYSTEM_METRICS.items():
+        for name, kind in sorted(declared.items()):
+            found = names.get(name)
+            if not found:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"declared for the {prefix}* subsystem but never "
+                     f"recorded by any instrumentation site"))
+            elif kind not in found:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"declared as {kind} but recorded as {sorted(found)}"))
+        for name in sorted(names):
+            if name.startswith(prefix) and name not in declared:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"new {prefix}* metric not declared in "
+                     f"SUBSYSTEM_METRICS (update the contract + docs)"))
     return names, errors
 
 
