@@ -47,7 +47,8 @@ def _configure(lib):
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, u64,
-        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, u64]
     lib.mxt_pipeline_num_records.restype = ctypes.c_int64
     lib.mxt_pipeline_num_records.argtypes = [ctypes.c_void_p]
     lib.mxt_pipeline_next.restype = ctypes.c_int
@@ -55,6 +56,18 @@ def _configure(lib):
         ctypes.c_void_p,
         ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
         ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+    lib.mxt_pipeline_next_lease.restype = ctypes.c_int
+    lib.mxt_pipeline_next_lease.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)), ctypes.POINTER(u64)]
+    lib.mxt_pipeline_return.restype = ctypes.c_int
+    lib.mxt_pipeline_return.argtypes = [ctypes.c_void_p, u64]
+    lib.mxt_pipeline_leased.restype = ctypes.c_int
+    lib.mxt_pipeline_leased.argtypes = [ctypes.c_void_p]
+    lib.mxt_pipeline_cache_stats.restype = None
+    lib.mxt_pipeline_cache_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u64), ctypes.POINTER(u64),
+        ctypes.POINTER(u64)]
     lib.mxt_pipeline_error.restype = ctypes.c_char_p
     lib.mxt_pipeline_error.argtypes = [ctypes.c_void_p]
     lib.mxt_pipeline_reset.argtypes = [ctypes.c_void_p]

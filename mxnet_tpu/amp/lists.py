@@ -45,12 +45,6 @@ FP32_OPS = [
     'log1p',
     'expm1',
     'power',
-    'square',
-    'sqrt',
-    'rsqrt',
-    'cbrt',
-    'rcbrt',
-    'reciprocal',
     'erfinv',
     'gamma',
     'gammaln',
@@ -169,9 +163,12 @@ def derive_policy(name):
         return 'lp16'
     if toks & _FP32_TOKENS:
         return 'fp32'
+    # accumulation-sensitive reductions only: cheap elementwise math
+    # (sqrt, square, reciprocal, rsqrt, rcbrt, cbrt) runs in the dtype it
+    # receives — pinning those to fp32 upcast bf16 activations
+    # mid-network and dragged every downstream op back to fp32
     if low in ('sum', 'prod', 'nansum', 'nanprod', 'max', 'min', 'amax',
-               'amin', 'average', 'trace', 'sqrt', 'square', 'cbrt',
-               'reciprocal', 'rsqrt', 'rcbrt'):
+               'amin', 'average', 'trace'):
         return 'fp32'
     if name.startswith(_WIDEST_PREF) or low in _WIDEST_NAMES:
         return 'widest'

@@ -33,6 +33,9 @@ class OpDef:
 
     def __init__(self, name: str, fn: Callable, num_outputs: int = 1,
                  mutate_inputs: tuple = (), nograd: bool = False, doc: str = ""):
+        # mutate_inputs: tuple of input indices the op rewrites in place,
+        # or the sentinel 'all' for variadic ops that mutate every input
+        # (resolve concrete indices with mutated_input_indices)
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
@@ -108,6 +111,14 @@ def register_op(name: Optional[str] = None, num_outputs: int = 1,
         _FN_OPNAMES.setdefault(raw, set()).add(opname)
         return fn
     return deco
+
+
+def mutated_input_indices(opdef: "OpDef", num_inputs: int) -> tuple:
+    """Concrete indices of the inputs `opdef` mutates, resolving the
+    'all' sentinel used by variadic in-place ops (e.g. reset_arrays)."""
+    if opdef.mutate_inputs == 'all':
+        return tuple(range(num_inputs))
+    return tuple(opdef.mutate_inputs)
 
 
 def register_op_alias(alias: str, canonical: str):

@@ -1,5 +1,7 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter)
+                 PrefetchingIter, DevicePrefetchIter, CSVIter, MNISTIter,
+                 ImageRecordIter)
 
 __all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'ResizeIter',
-           'PrefetchingIter', 'CSVIter', 'MNISTIter', 'ImageRecordIter']
+           'PrefetchingIter', 'DevicePrefetchIter', 'CSVIter', 'MNISTIter',
+           'ImageRecordIter']

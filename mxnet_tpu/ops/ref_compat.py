@@ -225,10 +225,13 @@ def linalg_gelqf(a):
     (ref: la_op.cc _linalg_gelqf). Lowered via QR of Aᵀ."""
     q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode='reduced')
     # normalize so L has a non-negative diagonal (LAPACK convention is
-    # sign-free; fixing the sign makes results deterministic/testable)
+    # sign-free; fixing the sign makes results deterministic/testable).
+    # A = L·Q = (L·D)(D·Q) for D = diag(sign(diag(L))), D² = I: scale
+    # the COLUMNS of L (rows of r before the transpose) and the rows of
+    # Q (columns of q) by the same D so the product is unchanged.
     d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
     d = jnp.where(d == 0, 1.0, d).astype(a.dtype)
-    l_mat = jnp.swapaxes(r * d[..., None, :], -1, -2)
+    l_mat = jnp.swapaxes(r * d[..., :, None], -1, -2)
     q_mat = jnp.swapaxes(q * d[..., None, :], -1, -2)
     return l_mat, q_mat
 
@@ -666,10 +669,11 @@ def multi_all_finite(*arrays, num_arrays=None, init_output=True):
     return ok.astype(jnp.float32).reshape(1)
 
 
-@_reg(nograd=True, mutate_inputs=(0,))
+@_reg(nograd=True, mutate_inputs='all')
 def reset_arrays(*arrays, num_arrays=None):
-    """Zero every input array (ref: contrib/reset_arrays.cc). Functional
-    form: returns the zeroed arrays; the NDArray layer rebinds handles."""
+    """Zero every input array (ref: contrib/reset_arrays.cc — EVERY
+    input is mutated, not just the first). Functional form: returns the
+    zeroed arrays; the NDArray layer rebinds handles."""
     return tuple(jnp.zeros_like(a) for a in arrays)
 
 

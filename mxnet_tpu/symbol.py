@@ -714,12 +714,23 @@ class Executor:
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         new_args = {}
         for n in self._names:
-            shape = kwargs.get(n, self.arg_dict[n].shape)
-            new_args[n] = nd_zeros(shape, self._ctx)
+            shape = tuple(kwargs.get(n, self.arg_dict[n].shape))
+            if shape == tuple(self.arg_dict[n].shape):
+                # unchanged args (weights) share storage with this
+                # executor, matching the reference's memory-sharing
+                # reshape — a reshaped executor computes the same
+                # function at the new batch size
+                new_args[n] = self.arg_dict[n]
+            else:
+                new_args[n] = nd_zeros(shape, self._ctx)
         grads = {n: nd_zeros(new_args[n].shape, self._ctx)
                  for n in self._names} if self._grad_req != 'null' else {}
+        # aux states (BN moving_mean/moving_var) are batch-independent:
+        # carry the SAME bindings over, not fresh zeros — dropping them
+        # silently broke inference-mode BN after a reshape
         return Executor(self._symbol, new_args, grads, self._grad_req,
-                        self._ctx, group2ctx=self._group2ctx)
+                        self._ctx, group2ctx=self._group2ctx,
+                        aux_states=self.aux_dict)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

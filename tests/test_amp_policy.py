@@ -39,6 +39,39 @@ def test_numerics_sensitive_is_fp32():
             assert table[op] == 'fp32', op
 
 
+def test_cheap_elementwise_not_pinned_fp32():
+    """sqrt/square/reciprocal/rsqrt/rcbrt/cbrt are bandwidth-bound
+    elementwise ops: pinning them to fp32 upcast bf16 activations
+    mid-network and dragged every downstream op back to fp32. They run
+    in whatever dtype they receive; fp32 stays reserved for
+    accumulation-sensitive reductions."""
+    table = lists.policy_table()
+    for op in ['sqrt', 'square', 'reciprocal', 'rsqrt', 'rcbrt', 'cbrt']:
+        if op in table:
+            assert table[op] == 'passthrough', op
+        assert op not in lists.FP32_OPS
+    for op in ['sum', 'mean', 'prod', 'nansum', 'norm']:
+        if op in table:
+            assert table[op] == 'fp32', op
+
+
+def test_amp_keeps_bf16_through_cheap_elementwise():
+    """amp.init('bfloat16'): a bf16 activation passes through sqrt
+    without an upcast to fp32."""
+    from mxnet_tpu import amp, nd
+    from mxnet_tpu.ndarray import array
+    amp.init('bfloat16')
+    try:
+        x = array(onp.ones((2, 3), onp.float32)).astype('bfloat16')
+        assert str(nd.sqrt(x).dtype) == 'bfloat16'
+        assert str(nd.square(x).dtype) == 'bfloat16'
+        # reductions still accumulate in fp32
+        assert str(nd.sum(x).dtype) == 'float32'
+    finally:
+        from mxnet_tpu.amp import amp as _amp_mod
+        _amp_mod._deinit()
+
+
 def test_integer_semantics_never_cast():
     table = lists.policy_table()
     for op in ['argmax', 'argmin', 'one_hot', 'topk', 'broadcast_equal',

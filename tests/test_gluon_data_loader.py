@@ -94,6 +94,40 @@ def test_shuffle_covers_dataset_each_epoch():
         onp.testing.assert_array_equal(onp.sort(seen), onp.arange(32))
 
 
+def test_persistent_worker_pool_across_epochs():
+    """One executor for the loader's lifetime: epoch 2 must reuse epoch
+    1's pool (and its threads), not build a fresh one per __iter__."""
+    x, y = _data(32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=8, num_workers=2)
+    list(loader)
+    pool1 = loader._pool
+    assert pool1 is not None
+    names1 = {t.name for t in threading.enumerate()
+              if t.name.startswith('mxtpu-dataloader')}
+    list(loader)
+    assert loader._pool is pool1
+    names2 = {t.name for t in threading.enumerate()
+              if t.name.startswith('mxtpu-dataloader')}
+    assert names1 == names2 and len(names1) <= 2
+    loader.close()
+    assert loader._pool is None
+    # the loader still works after close (pool lazily rebuilt)
+    assert len(list(loader)) == 4
+
+
+def test_pin_memory_batches_match():
+    """pin_memory=True device_puts batches from the workers without
+    changing their values or order."""
+    x, y = _data(24)
+    plain = list(DataLoader(ArrayDataset(x, y), batch_size=8))
+    pinned = list(DataLoader(ArrayDataset(x, y), batch_size=8,
+                             num_workers=2, pin_memory=True))
+    assert len(plain) == len(pinned)
+    for (ax, ay), (bx, by) in zip(plain, pinned):
+        onp.testing.assert_array_equal(ax.asnumpy(), bx.asnumpy())
+        onp.testing.assert_array_equal(ay.asnumpy(), by.asnumpy())
+
+
 def test_dataloader_used_from_training_thread():
     """A loader iterated from a worker thread while the main thread
     computes — the reference's decode-thread/train-thread split."""

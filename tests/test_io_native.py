@@ -179,28 +179,224 @@ def test_corrupt_record_raises(tmp_path):
     r.close()
 
 
-def test_partial_batch_parity(tmp_path):
-    """Native and PIL-fallback paths must agree on epoch size and padding."""
+def _force_fallback(monkeypatch):
+    """Disable the native pipeline so ImageRecordIter takes the
+    pure-Python path even for JPEG data."""
+    from mxnet_tpu.io import io as io_mod
+    monkeypatch.setattr(io_mod._NativePipeline, 'try_create',
+                        classmethod(lambda cls, *a, **k: None))
+
+
+@pytest.mark.parametrize('transport', ['u8', 'f32'])
+def test_partial_batch_parity(tmp_path, monkeypatch, transport):
+    """Native and PIL-fallback paths must agree on epoch size, padding,
+    and exact-zero pad rows — on both transports."""
     rec_path, _ = _write_rec(tmp_path, n=10, size=(16, 16))
 
     def epoch_stats(force_fallback):
-        it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
-                             batch_size=4)
-        if force_fallback and it._pipe is not None:
-            from mxnet_tpu import recordio as _r
-            it._pipe = None
-            it._record = _r.MXRecordIO(rec_path, 'r')
-            it._items = []
-            it._load_all()
-            it._order = onp.arange(len(it._items))
-            it.cursor = -4
-        batches = [(b.data[0].shape, b.pad) for b in it]
+        with monkeypatch.context() as mp:
+            if force_fallback:
+                _force_fallback(mp)
+            it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                                 batch_size=4, transport=transport,
+                                 mean_r=10.0, mean_g=20.0, mean_b=30.0)
+            assert (it._pipe is None) == force_fallback
+            batches = [(b.data[0].shape, b.pad,
+                        b.data[0].asnumpy()[4 - b.pad:]) for b in it]
         return batches
 
     native = epoch_stats(False)
     fallback = epoch_stats(True)
-    assert native == fallback == [((4, 3, 8, 8), 0), ((4, 3, 8, 8), 0),
-                                  ((4, 3, 8, 8), 2)]
+    assert [(s, p) for s, p, _ in native] \
+        == [(s, p) for s, p, _ in fallback] \
+        == [((4, 3, 8, 8), 0), ((4, 3, 8, 8), 0), ((4, 3, 8, 8), 2)]
+    # pad rows are exact zeros everywhere (the u8 transport masks them
+    # on device AFTER normalization — unmasked they would be -mean/std)
+    for _, pad, tail in native + fallback:
+        if pad:
+            assert onp.all(tail == 0.0)
+
+
+@pytest.mark.parametrize('native', [True, False])
+def test_u8_f32_transport_parity(tmp_path, monkeypatch, native):
+    """uint8 transport + device-side normalize must reproduce the f32
+    host-normalized batches within float rounding (1e-5)."""
+    rec_path, _ = _write_rec(tmp_path, n=13, size=(24, 20))
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 16, 16), batch_size=4,
+              mean_r=123.68, mean_g=116.78, mean_b=103.94,
+              std_r=58.4, std_g=57.1, std_b=57.4)
+    with monkeypatch.context() as mp:
+        if not native:
+            _force_fallback(mp)
+        it_f = ImageRecordIter(transport='f32', **kw)
+        it_u = ImageRecordIter(transport='u8', **kw)
+        assert (it_f._pipe is not None) == native
+        n = 0
+        for bf, bu in zip(it_f, it_u):
+            df = bf.data[0].asnumpy()
+            du = bu.data[0].asnumpy()
+            assert du.dtype == onp.float32
+            assert bf.pad == bu.pad
+            onp.testing.assert_allclose(df, du, atol=1e-5)
+            onp.testing.assert_array_equal(bf.label[0].asnumpy(),
+                                           bu.label[0].asnumpy())
+            n += 1
+        assert n == 4
+
+
+def test_lease_lifecycle(tmp_path):
+    """Zero-copy leases: exactly one outstanding while iterating,
+    drained at epoch end, and a mid-epoch reset returns them."""
+    rec_path, _ = _write_rec(tmp_path, n=16, size=(16, 16))
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                         batch_size=4, transport='u8')
+    assert it._pipe is not None
+    depths = []
+    for batch in it:
+        batch.data[0].asnumpy()   # consume while the lease is live
+        depths.append(it._pipe.leased_depth())
+    assert depths == [1, 1, 1, 1]    # the current batch's buffer only
+    assert it._pipe.leased_depth() == 0   # epoch end drains the lease
+    # mid-epoch reset returns the outstanding lease
+    it.reset()
+    next(iter(it))
+    assert it._pipe.leased_depth() == 1
+    it.reset()
+    assert it._pipe.leased_depth() == 0
+    assert sum(4 - b.pad for b in it) == 16   # clean epoch after reset
+
+
+def test_lease_buffer_valid_across_next(tmp_path):
+    """The previous batch stays correct after the next one is taken
+    (return-after-next protocol, no use-after-free of the lease)."""
+    rec_path, labels = _write_rec(tmp_path, n=12, size=(16, 16))
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                         batch_size=4, transport='u8')
+    it2 = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                          batch_size=4, transport='u8')
+    prev = None
+    for b, ref in zip(it, it2):
+        if prev is not None:
+            # materialized AFTER its lease was returned: the values
+            # were synced to device before release
+            onp.testing.assert_array_equal(prev[0], prev[1].data[0].asnumpy())
+        prev = (b.data[0].asnumpy().copy(), b)
+        ref_now = ref.data[0].asnumpy()
+        onp.testing.assert_array_equal(prev[0], ref_now)
+
+
+def test_decode_cache_reuse(tmp_path):
+    """Epoch 2+ serve decodes from the cache: hits recorded, bytes held
+    bounded, and batches identical to the cold epoch (no augmentation)."""
+    rec_path, _ = _write_rec(tmp_path, n=12, size=(16, 16))
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                         batch_size=4, transport='u8', decode_cache_mb=64)
+    e1 = [b.data[0].asnumpy().copy() for b in it]
+    hits1, misses1, nbytes = it._pipe.cache_stats()
+    assert hits1 == 0 and misses1 == 12 and nbytes > 0
+    it.reset()
+    e2 = [b.data[0].asnumpy().copy() for b in it]
+    hits2, misses2, _ = it._pipe.cache_stats()
+    assert hits2 == 12 and misses2 == 12
+    for a, b in zip(e1, e2):
+        onp.testing.assert_array_equal(a, b)
+    # cache off: every epoch decodes
+    it0 = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                          batch_size=4, transport='u8', decode_cache_mb=0)
+    list(it0)
+    it0.reset()
+    list(it0)
+    h, m, nb = it0._pipe.cache_stats()
+    assert h == 0 and m == 24 and nb == 0
+
+
+def test_device_prefetch_iter(tmp_path):
+    """DevicePrefetchIter yields the same batches in the same order as
+    its backing iterator, across epochs."""
+    from mxnet_tpu.io import DevicePrefetchIter
+    rec_path, _ = _write_rec(tmp_path, n=14, size=(16, 16))
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 8, 8), batch_size=4,
+              transport='u8')
+    ref = [b.data[0].asnumpy().copy() for b in ImageRecordIter(**kw)]
+    pre = DevicePrefetchIter(ImageRecordIter(**kw), depth=2)
+    for _ in range(2):
+        got = [(b.data[0].asnumpy().copy(), b.pad) for b in pre]
+        assert [g[1] for g in got] == [0, 0, 0, 2]
+        for r, (g, _) in zip(ref, got):
+            onp.testing.assert_array_equal(r, g)
+        pre.reset()
+
+
+def test_device_prefetch_iter_next_getdata_protocol(tmp_path):
+    """The iter_next()/getdata() half of the DataIter protocol must
+    serve every batch exactly once (not consume into a dead peek)."""
+    from mxnet_tpu.io import DevicePrefetchIter
+    rec_path, _ = _write_rec(tmp_path, n=10, size=(16, 16))
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 8, 8), batch_size=4,
+              transport='u8')
+    ref = [(b.data[0].asnumpy().copy(), b.pad)
+           for b in ImageRecordIter(**kw)]
+    it = DevicePrefetchIter(ImageRecordIter(**kw), depth=2)
+    got = []
+    while it.iter_next():
+        got.append((it.getdata()[0].asnumpy().copy(), it.getpad()))
+        assert it.getlabel()[0].shape == (4,)
+    assert len(got) == len(ref) == 3
+    for (r, rp), (g, gp) in zip(ref, got):
+        assert rp == gp
+        onp.testing.assert_array_equal(r, g)
+
+
+def test_prefetching_iter_propagates_worker_error():
+    """An exception in the prefetch worker must surface in the
+    consumer, not deadlock it on an empty queue."""
+    from mxnet_tpu.io import DataIter, PrefetchingIter
+
+    class Broken(DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n >= 3:
+                raise RuntimeError("corrupt record")
+            return self.n
+
+        def reset(self):
+            self.n = 0
+
+    pre = PrefetchingIter(Broken())
+    assert pre.next() == 1
+    assert pre.next() == 2
+    with pytest.raises(RuntimeError, match="corrupt record"):
+        pre.next()
+
+
+def test_host_bytes_telemetry(tmp_path):
+    """mxnet_tpu_io_host_bytes_total counts transported bytes: the u8
+    path moves 4x less than f32 for the same batches."""
+    from mxnet_tpu import telemetry
+    rec_path, _ = _write_rec(tmp_path, n=8, size=(16, 16))
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 8, 8), batch_size=4)
+
+    def run(transport):
+        before = telemetry.counter(
+            'mxnet_tpu_io_host_bytes_total').value() or 0
+        list(ImageRecordIter(transport=transport, **kw))
+        return (telemetry.counter(
+            'mxnet_tpu_io_host_bytes_total').value() or 0) - before
+
+    was_on = telemetry.enabled()
+    telemetry.enable()
+    try:
+        u8_bytes = run('u8')
+        f32_bytes = run('f32')
+    finally:
+        if not was_on:
+            telemetry.disable()
+    assert u8_bytes == 2 * 4 * 3 * 8 * 8        # 2 batches of u8 NHWC
+    assert f32_bytes == 4 * u8_bytes            # f32 NCHW is 4x
 
 
 def test_png_dataset_falls_back(tmp_path):

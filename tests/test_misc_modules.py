@@ -286,6 +286,42 @@ def test_symbol_auto_params_json_roundtrip_binds():
     assert ex.arg_dict['fc1_bias'].shape == (8,)
 
 
+def test_executor_reshape_threads_aux_states():
+    """Executor.reshape must carry BN moving_mean/moving_var bindings
+    (and unchanged weights) into the new executor — they were silently
+    replaced with zeros, breaking inference-mode BN after a reshape."""
+    import jax.numpy as jnp
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    data = sym.Variable('data')
+    bn = sym.BatchNorm(data, name='bn')
+    bn0 = bn[0] if isinstance(bn, tuple) else bn
+    net = sym.FullyConnected(bn0, num_hidden=3, name='fc')
+    exe = net.simple_bind(mx.cpu(), data=(4, 5))
+    rs = onp.random.RandomState(0)
+    for n, a in exe.arg_dict.items():
+        if n != 'data':
+            a._data = jnp.asarray(rs.randn(*a.shape).astype('float32'))
+    exe.aux_dict['bn_moving_mean']._data = \
+        jnp.asarray(onp.full((5,), 0.25, 'float32'))
+    exe.aux_dict['bn_moving_var']._data = \
+        jnp.asarray(onp.full((5,), 2.0, 'float32'))
+    x4 = rs.randn(4, 5).astype('float32')
+    out4 = exe.forward(is_train=False, data=x4)[0].asnumpy()
+
+    exe2 = exe.reshape(data=(8, 5))
+    assert set(exe2.aux_dict) == {'bn_moving_mean', 'bn_moving_var'}
+    onp.testing.assert_allclose(
+        exe2.aux_dict['bn_moving_var'].asnumpy(), 2.0)
+    out8 = exe2.forward(is_train=False,
+                        data=onp.concatenate([x4, x4]))[0].asnumpy()
+    # same function at the new batch size: weights AND moving stats kept
+    onp.testing.assert_allclose(out8[:4], out4, atol=1e-5)
+    onp.testing.assert_allclose(out8[4:], out4, atol=1e-5)
+
+
 def test_batchnorm_auto_params_are_aux_states():
     """Auto-created BN moving stats classify as AUXILIARY states:
     excluded from arguments/gradients/optimizer updates, allocated with

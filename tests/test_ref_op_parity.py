@@ -138,6 +138,21 @@ class TestRefCompatOps:
                                     atol=1e-5)
         assert (onp.diagonal(l_mat) >= 0).all()
 
+    def test_linalg_gelqf_mixed_signs(self):
+        """Sign normalization must scale the COLUMNS of L (and rows of
+        Q) by the same D: scaling rows of L reconstructed 4x6 inputs
+        with error ~4 whenever diag(R) had mixed signs (ADVICE r5)."""
+        import jax.numpy as jnp
+        for seed in (1, 2, 3):
+            a = onp.random.RandomState(seed).randn(4, 6).astype(onp.float32)
+            l_mat, q = get_op('_linalg_gelqf').fn(jnp.asarray(a))
+            l_mat, q = onp.asarray(l_mat), onp.asarray(q)
+            onp.testing.assert_allclose(l_mat @ q, a, atol=1e-5)
+            onp.testing.assert_allclose(q @ q.T, onp.eye(4), atol=1e-5)
+            assert (onp.diagonal(l_mat) >= 0).all(), seed
+            # L stays lower-triangular after the sign fix
+            onp.testing.assert_allclose(l_mat, onp.tril(l_mat), atol=1e-6)
+
     def test_linalg_syevd(self):
         import jax.numpy as jnp
         rs = onp.random.RandomState(1)
@@ -266,6 +281,15 @@ class TestRefCompatOps:
         assert float(bad[0]) == 0.0
         z = get_op('reset_arrays').fn(jnp.ones(3), jnp.ones((2, 2)))
         assert all(float(onp.asarray(x).sum()) == 0 for x in z)
+        # reset_arrays mutates EVERY input, not just the first — the
+        # 'all' sentinel resolves to one index per passed array
+        from mxnet_tpu.base import _OP_REGISTRY, mutated_input_indices
+        od = _OP_REGISTRY['reset_arrays']
+        assert od.mutate_inputs == 'all'
+        assert mutated_input_indices(od, 3) == (0, 1, 2)
+        assert mutated_input_indices(
+            _OP_REGISTRY['sgd_mom_update'],
+            4) == tuple(_OP_REGISTRY['sgd_mom_update'].mutate_inputs)
 
     def test_square_sum_and_argmax_channel(self):
         import jax.numpy as jnp

@@ -40,6 +40,28 @@ CALL_RE = re.compile(
 # metric under the subsystem prefix without declaring it here — keeping
 # code, docs and dashboards from drifting apart silently.
 SUBSYSTEM_METRICS = {
+    'mxnet_tpu_io_': {
+        # batch production
+        'mxnet_tpu_io_batches_total': 'counter',
+        'mxnet_tpu_io_batch_latency_seconds': 'histogram',
+        # host-boundary traffic: bytes the python layer pulls out of the
+        # pipeline per batch (u8 transport moves ~4x less than f32)
+        'mxnet_tpu_io_host_bytes_total': 'counter',
+        # zero-copy buffer leases outstanding against the native pipeline
+        'mxnet_tpu_io_lease_depth': 'gauge',
+        # decode cache (decoded+resized images reused across epochs)
+        'mxnet_tpu_io_decode_cache_hits_total': 'counter',
+        'mxnet_tpu_io_decode_cache_misses_total': 'counter',
+        'mxnet_tpu_io_decode_cache_bytes': 'gauge',
+        # decode-prefetch health (PrefetchingIter)
+        'mxnet_tpu_io_prefetch_miss_total': 'counter',
+        'mxnet_tpu_io_prefetch_stall_seconds_total': 'counter',
+        # device prefetch: batches staged on device ahead of the
+        # consumer, and the dispatch-to-consume window each host->device
+        # copy had to overlap compute in
+        'mxnet_tpu_io_device_prefetch_depth': 'gauge',
+        'mxnet_tpu_io_h2d_overlap_seconds_total': 'counter',
+    },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
         'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
