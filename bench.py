@@ -7,9 +7,11 @@ Robustness contract (this script is a driver artifact): it ALWAYS prints
 exactly ONE JSON line on stdout, with "metric"/"value"/"unit"/
 "vs_baseline" plus "backend" and (on any failure) "error" fields.
 
-Schedule (worst case ~14 min, under any sane driver timeout):
-  1. PROBE child (<=60 s): import jax, list devices, one tiny matmul on
-     the accelerator. A wedged TPU tunnel fails here cheaply.
+Schedule (worst case ~16 min, under any sane driver timeout):
+  1. PROBE child (<=60 s, one retry after 10 s backoff): import jax,
+     list devices, one tiny matmul on the accelerator. A wedged TPU
+     tunnel fails here cheaply; its state is reported in the final
+     JSON's "probe" field, never in top-level "error".
   2. If the probe saw an accelerator: ONE measurement child (<=540 s)
      with the JAX persistent compilation cache enabled, so a BERT-base
      compile paid once is never paid again. No identical retry.
@@ -561,15 +563,31 @@ def main():
             _child(sys.argv[2])
         return
 
-    errors = []
-    _log("probe: checking backend liveness (<=60s)")
-    probe, perr = _run_child('probe', 60.0)
-    accel_alive = probe is not None and probe.get('platform') != 'cpu'
-    if probe is None:
-        errors.append(f"probe: {perr}")
+    # Probe state rides in the separate "probe" field of the final JSON —
+    # NEVER in top-level "error": a wedged-tunnel probe timeout on an
+    # otherwise-valid CPU smoke line previously leaked as "error" and
+    # dirtied the parsed metric (BENCH_r05). One retry with backoff
+    # covers the transient tunnel hiccup case.
+    errors = []   # measurement-child failures only
+    probe, perr = None, None
+    attempts_made = 0
+    for attempt in range(2):
+        attempts_made = attempt + 1
+        _log(f"probe attempt {attempts_made}: backend liveness (<=60s)")
+        probe, perr = _run_child('probe', 60.0)
+        if probe is not None:
+            _log(f"probe: {probe}")
+            break
         _log(f"probe failed: {perr}")
-    else:
-        _log(f"probe: {probe}")
+        if attempt == 0:
+            _log("probe retry in 10s (tunnel may be transiently wedged)")
+            time.sleep(10.0)
+    probe_info = dict(probe) if probe is not None else {}
+    probe_info['state'] = 'ok' if probe is not None else 'wedged'
+    probe_info['attempts'] = attempts_made
+    if probe is None:
+        probe_info['error'] = perr
+    accel_alive = probe is not None and probe.get('platform') != 'cpu'
 
     attempts = []
     if accel_alive:
@@ -580,8 +598,7 @@ def main():
         _log(f"attempt mode={mode} timeout={timeout:.0f}s")
         out, err = _run_child(mode, timeout)
         if out is not None:
-            if probe is not None:
-                out['probe'] = probe
+            out['probe'] = probe_info
             if errors:
                 out['error'] = '; '.join(errors)
             print(json.dumps(out), flush=True)
@@ -595,6 +612,7 @@ def main():
         "unit": "% MFU",
         "vs_baseline": 0.0,
         "backend": "none",
+        "probe": probe_info,
         "error": '; '.join(errors),
     }), flush=True)
 

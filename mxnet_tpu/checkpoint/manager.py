@@ -308,8 +308,23 @@ class CheckpointManager:
         if self.save_rng:
             from .. import random as _random
             rng = _random.get_state()
+        meta = dict(metadata or {})
+        if 'trainer_states' in blobs and self._trainer is not None:
+            # The states payload is ALWAYS host-gathered fp32 (both
+            # Trainer.get_states_bytes and ShardedTrainStep gather their
+            # ZeRO shards), so a checkpoint restores at any dp degree and
+            # into ZeRO or replicated trainers alike. Record the layout
+            # it was written UNDER so cross-degree resumes are auditable.
+            tr = self._trainer
+            meta.setdefault('optimizer_state_layout', {
+                'format': 'gathered-host',
+                'zero1': bool(getattr(tr, '_zero_active', False)
+                              or getattr(tr, 'zero', False)),
+                'dp': int(getattr(tr, '_zero_dp', 0)
+                          or getattr(tr, '_dp_size', 1)),
+            })
         return {'step': int(step), 'arrays': arrays, 'blobs': blobs,
-                'rng': rng, 'metadata': metadata or {}}
+                'rng': rng, 'metadata': meta}
 
     def _write_and_commit(self, snap: dict, t_start: float) -> None:
         try:

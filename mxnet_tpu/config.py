@@ -167,3 +167,12 @@ register('MXNET_TPU_RECOMPILE_WARN_THRESHOLD', int, 3,
          'when one site, e.g. a hybridized block, compiles more than '
          'this many times — churning input shapes/dtypes force an XLA '
          'recompile every step.')
+register('MXTPU_ZERO', _bool, True,
+         'ZeRO-1 sharded optimizer update on the GSPMD data-parallel '
+         'path: gradients reduce-scatter over the dp axis, each device '
+         'runs the optimizer on its 1/dp slice of the fp32 masters and '
+         'moments, and updated params all-gather back to the compute '
+         'dtype — all inside the one pjit step so XLA overlaps the '
+         'collectives with backward compute. Default on whenever a dp '
+         'axis with >1 devices is present; set 0 to force the fully '
+         'replicated update.')

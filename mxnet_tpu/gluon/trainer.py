@@ -48,6 +48,10 @@ class Trainer:
         # steps (eval pass, checkpoint save) as step time.
         self._telem_last_step = None
         self._telem_step_ema = None
+        # ZeRO-1 state of the fused update; populated by _fused_apply
+        # when the weights live on a >1-device dp mesh (see _zero_layout)
+        self._zero_active = False
+        self._zero_dp = 1
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -72,9 +76,21 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def _compression_requested(self):
+        return self._compression_params is not None and \
+            self._compression_params.get('type', '2bit') != 'none'
+
     def _init_kvstore(self):
         """Ref: trainer.py:174."""
         if self._kvstore_type is None or self._kvstore_type is False:
+            if self._compression_requested():
+                raise MXNetError(
+                    "gradient compression requires a kvstore: with "
+                    "kvstore=None the gradients never pass a push where "
+                    "compress_decompress could run, so the setting would "
+                    "be silently ignored. Create the Trainer with "
+                    "kvstore='device' (multi-copy) or drop "
+                    "compression_params.")
             self._kvstore = None
             if self._update_on_kvstore is None:
                 self._update_on_kvstore = False
@@ -156,6 +172,22 @@ class Trainer:
                 continue
             grads = param.list_grad()
             if len(grads) == 1 and self._kvstore.num_workers == 1:
+                if self._compression_requested() and \
+                        not self._update_on_kvstore:
+                    # update_on_kvstore pushes in _update (compression
+                    # applies there); THIS path skips the push entirely
+                    # the GSPMD / single-copy path never pushes, so the
+                    # 2bit quantization would be silently skipped —
+                    # surface that instead (ISSUE 4 satellite)
+                    raise MXNetError(
+                        "gradient compression is configured but parameter "
+                        f"'{param.name}' has a single gradient copy and "
+                        "one worker: the kvstore push that applies "
+                        "compression is skipped on this (GSPMD mesh / "
+                        "single-device) path, so the setting would be "
+                        "silently ignored. Drop compression_params or "
+                        "train with per-context gradient copies "
+                        "(multi-copy kvstore) / dist_sync workers.")
                 continue
             if self._update_on_kvstore:
                 continue  # push+pull happens in _update via kvstore updater
@@ -206,11 +238,134 @@ class Trainer:
                 self._updater(i, g, datas[0])
         # broadcast the updated first copy to the other context copies
         # (ref: trainer.py:430 per-device update; collapsed so state
-        # copies don't ping-pong between devices)
+        # copies don't ping-pong between devices). ONE batched
+        # device_put for every (param, copy) pair — per-array transfers
+        # paid a dispatch round-trip per parameter per step.
+        dsts, srcs, shards = [], [], []
         for i, param, g, datas in items:
             src = datas[0]._data
             for d in datas[1:]:
-                d._data = jax.device_put(src, d._data.sharding)
+                dsts.append(d)
+                srcs.append(src)
+                shards.append(d._data.sharding)
+        if dsts:
+            for d, out in zip(dsts, jax.device_put(srcs, shards)):
+                d._data = out
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.counter(
+                    'mxnet_tpu_comm_collective_bytes_total').inc(
+                        sum(int(s.size) * s.dtype.itemsize for s in srcs),
+                        kind='broadcast', axis='ctx')
+                _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
+                    1, kind='broadcast', axis='ctx')
+
+    def _zero_layout(self, items):
+        """Mesh layout for the fused update, or None when the weights'
+        primary copies do not all live on one NamedSharding mesh. When
+        they do, the optimizer states must be placed on that mesh too
+        (a jit cannot mix device sets). 'zero' is set when MXTPU_ZERO
+        allows (default on) and the mesh has a 'dp' axis of >1 devices:
+        each optimizer-state tensor (fp32 master + moments) then shards
+        1/dp over that axis — the traced multi-tensor update computes
+        only the local slice and all-gathers the updated weights back to
+        their own layout. With zero off the states replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.step import compose_zero_spec
+        mesh = None
+        for _, _, _, datas in items:
+            sh = datas[0]._data.sharding
+            if not isinstance(sh, NamedSharding):
+                return None
+            if mesh is None:
+                mesh = sh.mesh
+            elif sh.mesh != mesh:
+                return None
+        if mesh is None:
+            return None
+        from .. import config as _config
+        dp = dict(mesh.shape).get('dp', 0)
+        zero_on = bool(_config.get('MXTPU_ZERO')) and dp > 1
+        w_sh, state_sh = [], []
+        for _, _, _, datas in items:
+            sh = datas[0]._data.sharding
+            w_sh.append(sh)
+            zspec = compose_zero_spec(tuple(datas[0].shape), sh.spec,
+                                      'dp', dp) if zero_on else None
+            state_sh.append(NamedSharding(mesh, zspec)
+                            if zspec is not None else None)
+        return {'mesh': mesh, 'dp': dp if zero_on else 1, 'zero': zero_on,
+                'w_sh': w_sh, 'state_sh': state_sh,
+                'repl': NamedSharding(mesh, PartitionSpec())}
+
+    def _zero_place_states(self, items, zero):
+        """Scatter optimizer-state NDArrays into the ZeRO layout (one
+        batched transfer). Weight-shaped leaves take the param's 1/dp
+        spec; everything else replicates onto the mesh so the fused jit
+        sees one device set. Re-runs after set_states_bytes — a restored
+        payload is host-gathered numpy, so checkpoints stay
+        layout-independent and resume at any dp degree."""
+        import jax
+        from ..ndarray.ndarray import NDArray
+        pending = []
+
+        def _walk(s, target, wshape):
+            if isinstance(s, NDArray):
+                sh = target if tuple(s._data.shape) == wshape \
+                    else zero['repl']
+                if s._data.sharding != sh:
+                    pending.append((s, sh))
+            elif isinstance(s, (list, tuple)):
+                for x in s:
+                    _walk(x, target, wshape)
+
+        for n, (i, p, g, datas) in enumerate(items):
+            # no 1/dp spec -> weight-shaped leaves follow the weight's own
+            # layout (fsdp-style dp-sharded weights keep sharded states)
+            _walk(self._updater.states[i],
+                  zero['state_sh'][n] or zero['w_sh'][n],
+                  tuple(datas[0].shape))
+        if pending:
+            placed = jax.device_put([s._data for s, _ in pending],
+                                    [sh for _, sh in pending])
+            nbytes = 0
+            for (s, _), d in zip(pending, placed):
+                s._data = d
+                nbytes += int(d.size) * d.dtype.itemsize
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.counter(
+                    'mxnet_tpu_comm_collective_bytes_total').inc(
+                        nbytes, kind='state_scatter', axis='dp')
+                _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
+                    1, kind='state_scatter', axis='dp')
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.set_gauge(
+                'mxnet_tpu_comm_opt_state_bytes_per_device',
+                self.opt_state_bytes_per_device())
+
+    def opt_state_bytes_per_device(self):
+        """Bytes of optimizer state ONE device holds (ZeRO-1: ~1/dp of
+        the replicated footprint, ± tensors too small to shard)."""
+        from ..ndarray.ndarray import NDArray
+        total = 0
+
+        def _walk(s):
+            nonlocal total
+            if isinstance(s, NDArray):
+                d = s._data
+                shards = getattr(d, 'addressable_shards', None)
+                total += shards[0].data.nbytes if shards \
+                    else int(d.size) * d.dtype.itemsize
+            elif isinstance(s, (list, tuple)):
+                for x in s:
+                    _walk(x)
+
+        if self._updater is not None:
+            for st in self._updater.states.values():
+                _walk(st)
+        return total
 
     def _fused_apply(self, items):
         """Run every parameter update as ONE compiled XLA program.
@@ -249,6 +404,13 @@ class Trainer:
                 updater.states[i] = opt.create_state_multi_precision(
                     i, datas[0])
                 updater.states_synced[i] = True
+        # mesh-resident weights: states must live on the same mesh —
+        # sharded 1/dp under ZeRO-1, replicated otherwise. Layout
+        # detection + placement walk every param, so they run only in
+        # the cache-(re)build branch below (first step, new param
+        # set/dtype, or after set_states_bytes cleared the cache to
+        # re-scatter a restore) — never on the per-step hot path.
+        zero = None
 
         def _flat(s, out):
             if isinstance(s, NDArray):
@@ -268,10 +430,18 @@ class Trainer:
 
         sig = (tuple(indices), opt.__class__,
                tuple(d._data.dtype.name for _, _, _, ds in items
-                     for d in ds[:1]))
+                     for d in ds[:1]),
+               (self._zero_active, self._zero_dp))
         cache = getattr(self, '_fused_cache', None)
         if cache is None or cache[0] != sig:
+            zero = self._zero_layout(items)
+            self._zero_active = zero is not None and zero['zero']
+            self._zero_dp = zero['dp'] if zero else 1
+            if zero is not None:
+                self._zero_place_states(items, zero)
+            sig = sig[:3] + ((self._zero_active, self._zero_dp),)
             structs = [updater.states[i] for i in indices]
+            zero_cache = zero
 
             # wds ride as a STATIC tuple: the ops branch on `if wd` with
             # python control flow, so weight decay must be concrete at
@@ -298,10 +468,25 @@ class Trainer:
                     new_w, new_s = [], []
                     for n, idx in enumerate(indices):
                         w = NDArray(weights[n])
-                        g = NDArray(grads[n])
+                        gdat = grads[n]
+                        if zero_cache is not None and \
+                                zero_cache['state_sh'][n] is not None:
+                            # the grad is consumed against 1/dp-sharded
+                            # moments: constrain it so the partitioner
+                            # slices once up front instead of keeping
+                            # the full copy live through the update
+                            gdat = jax.lax.with_sharding_constraint(
+                                gdat, zero_cache['state_sh'][n])
+                        g = NDArray(gdat)
                         st = _reshape(structs[n], leaves)
                         opt.update_multi_precision(idx, w, g, st)
-                        new_w.append(w._data)
+                        wd_ = w._data
+                        if zero_cache is not None:
+                            # all-gather the updated weight back to its
+                            # own (replicated / tp) layout
+                            wd_ = jax.lax.with_sharding_constraint(
+                                wd_, zero_cache['w_sh'][n])
+                        new_w.append(wd_)
                         new_s.extend(_flat(st, []))
                 finally:
                     for name in ('_get_lr', '_get_wd', '_update_count'):
@@ -310,8 +495,17 @@ class Trainer:
                     opt.rescale_grad = saved_rescale
                 return new_w, new_s
 
+            jit_kwargs = {}
+            if zero_cache is not None:
+                # pin outputs: weights back to their own layout, state
+                # leaves to the ZeRO layout they arrived in (donation
+                # then reuses the sharded buffers in place)
+                leaf_sh = [x.sharding for i in indices
+                           for x in _flat(updater.states[i], [])]
+                jit_kwargs['out_shardings'] = (
+                    [s for s in zero_cache['w_sh']], leaf_sh)
             jitted = jax.jit(fused, donate_argnums=(0, 2),
-                             static_argnums=(6,))
+                             static_argnums=(6,), **jit_kwargs)
             self._fused_cache = (sig, fused, jitted)
             self._fused_traced = False
         elif _telem['on']:

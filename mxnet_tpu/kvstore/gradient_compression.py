@@ -9,12 +9,20 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
 
 class GradientCompression:
     def __init__(self, ctype='2bit', threshold=0.5):
-        assert ctype in ('none', '2bit')
+        if ctype not in ('none', '2bit'):
+            # explicit rejection, not a bare assert: user scripts pass
+            # e.g. type='fp16' (a later reference addition) and must get
+            # an actionable error instead of an AssertionError
+            raise MXNetError(
+                f"gradient compression type {ctype!r} is not supported "
+                f"(supported: 'none', '2bit'). The reference's fp16 "
+                f"compression has no TPU-path implementation here.")
         self.type = ctype
         self.threshold = float(threshold)
         self._residual = {}

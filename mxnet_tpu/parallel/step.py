@@ -8,6 +8,14 @@ sharded over the 'dp' axis; XLA inserts the gradient all-reduce over ICI.
 Buffer donation on params/optimizer state gives the reference's
 static-alloc in-place update behavior (ref: CachedOp static_alloc,
 src/imperative/cached_op.cc:525).
+
+ZeRO-1 (default on whenever the dp axis has >1 devices, gate with
+MXTPU_ZERO=0 or zero=False): the fp32 masters and optimizer moments are
+dp-SHARDED PartitionSpecs instead of replicated, so the grad all-reduce
+becomes a reduce-scatter, each device updates only its 1/dp slice, and
+the updated params all-gather back — same wire bytes, 1/dp optimizer
+math and state HBM per device. See the mxnet_tpu_comm_* telemetry
+contract for the per-run accounting.
 """
 from __future__ import annotations
 
@@ -18,7 +26,7 @@ import jax.numpy as jnp
 import numpy as onp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..base import state as _flags
+from ..base import MXNetError, state as _flags, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from .. import random as _random
 from .mesh import default_mesh
@@ -54,6 +62,32 @@ def _local_value(arr):
     if jax.process_count() > 1 and not arr.is_fully_addressable:
         return arr.addressable_data(0)
     return arr
+
+
+def compose_zero_spec(shape, base_spec, dp_axis, dp_size):
+    """ZeRO-1 layout for an optimizer-state/master tensor: compose a dp
+    shard onto the parameter's (tp) PartitionSpec. Picks the first dim
+    not already claimed by another mesh axis whose size splits evenly
+    over dp; falls back to a padded (ragged) shard when only an uneven
+    dim is available. None when nothing is shardable (scalars and
+    sub-dp-size tensors stay replicated — they are the ±padding slack in
+    the 1/dp state-footprint accounting)."""
+    spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    for s in spec:
+        # already sharded over dp (fsdp-style param_specs): the state
+        # inherits the param's own 1/dp layout — composing again would
+        # produce an invalid duplicate-axis spec
+        if s == dp_axis or (isinstance(s, (tuple, list)) and dp_axis in s):
+            return None
+    for exact in (True, False):
+        for i, s in enumerate(spec):
+            if s is not None or shape[i] < dp_size:
+                continue
+            if exact and shape[i] % dp_size != 0:
+                continue
+            spec[i] = dp_axis
+            return P(*spec)
+    return None
 
 
 def _sgd_init(p):
@@ -130,7 +164,7 @@ class ShardedTrainStep:
 
     def __init__(self, block, loss_fn, optimizer='sgd', optimizer_params=None,
                  mesh=None, dp_axis='dp', param_specs=None, donate=True,
-                 grad_dtype=None):
+                 grad_dtype=None, zero=None, compression_params=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -143,11 +177,33 @@ class ShardedTrainStep:
         self._opt_init, self._opt_update = _OPTS[optimizer]
         self.param_specs = param_specs or {}
         self.donate = donate
+        if compression_params is not None and \
+                compression_params.get('type', '2bit') != 'none':
+            # surfaced, not silently dropped: the GSPMD path has no
+            # kvstore push where compress_decompress could hook in — the
+            # gradient reduction is an XLA collective inside the step
+            raise MXNetError(
+                f"gradient compression "
+                f"(type={compression_params.get('type', '2bit')!r}) is not "
+                f"supported on the GSPMD/ShardedTrainStep path: the "
+                f"gradient all-reduce is emitted by XLA inside the "
+                f"compiled step, so there is no kvstore push to compress. "
+                f"Use the kvstore Trainer path (multi-copy or "
+                f"dist_sync), or drop compression_params.")
+        dp_size = dict(self.mesh.shape).get(self.dp_axis, 1)
+        if zero is None:
+            from .. import config as _cfg
+            zero = _cfg.get('MXTPU_ZERO')
+        # ZeRO-1: default-on when a >1-device dp axis exists (the fp32
+        # masters + Adam moments then live 1/dp per device)
+        self.zero = bool(zero) and dp_size > 1
+        self._dp_size = dp_size
         self._params = None       # list[(name, Parameter)]
         self._master = None       # fp32 master copies of bf16/fp16 params
         self._opt_state = None
         self._compiled = None
         self._step_count = 0
+        self._pending_states = None   # restored blob awaiting first build
 
     # ------------------------------------------------------------------
     def _collect(self):
@@ -234,6 +290,44 @@ class ShardedTrainStep:
             aux = {n: proxies[n]._data for n in f_names}
             return loss_val, aux
 
+        # shardings
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, P(self.dp_axis))
+
+        t_shardings = {n: NamedSharding(mesh, self._spec_for(n))
+                       for n in t_names}
+        f_shardings = {n: NamedSharding(mesh, self._spec_for(n))
+                       for n in f_names}
+        # ZeRO-1 (Rajbhandari et al., 2020, stage 1): the fp32 masters and
+        # Adam moments shard 1/dp over the dp axis (composed with any tp
+        # dims the param already shards). The update then reads a
+        # dp-SHARDED gradient — the constraint below turns the plain
+        # all-reduce into reduce-scatter — and out_shardings all-gather
+        # the updated param back to its replicated/tp layout. GSPMD fuses
+        # and overlaps both collectives with backward compute.
+        zero_specs = {n: None for n in t_names}
+        if self.zero:
+            shapes = {n: tuple(p.data().shape) for n, p in trainable}
+            for n in t_names:
+                zero_specs[n] = compose_zero_spec(
+                    shapes[n], self._spec_for(n), self.dp_axis,
+                    self._dp_size)
+        self.zero_specs = zero_specs
+        zero_shardings = {
+            n: (NamedSharding(mesh, zero_specs[n])
+                if zero_specs[n] is not None else t_shardings[n])
+            for n in t_names}
+        # optimizer state shards like its parameter (ZeRO: like its slice)
+        state_shardings = {
+            n: tuple((repl if s.ndim == 0 else zero_shardings[n])
+                     for s in self._opt_state[n])
+            for n in t_names}
+
+        master_shardings = {n: zero_shardings[n] for n in master_names}
+        shard_constraint = {n: zero_shardings[n] for n in t_names
+                            if zero_specs[n] is not None}
+
         def train_step(t_params, f_params, master, opt_state, inputs,
                        labels, key, lr):
             (loss_val, aux), grads = jax.value_and_grad(
@@ -244,8 +338,18 @@ class ShardedTrainStep:
             new_state = {}
             for n in t_names:
                 g32 = grads[n].astype(jnp.float32)
-                p32 = master[n] if n in master_names \
-                    else t_params[n].astype(jnp.float32)
+                zsh = shard_constraint.get(n)
+                if zsh is not None:
+                    # reduce-scatter: the grad is only ever consumed in
+                    # this dp-sharded layout, so the partitioner combines
+                    # the backward psum + slice into one reduce-scatter
+                    g32 = jax.lax.with_sharding_constraint(g32, zsh)
+                if n in master_names:
+                    p32 = master[n]
+                else:
+                    p32 = t_params[n].astype(jnp.float32)
+                    if zsh is not None:
+                        p32 = jax.lax.with_sharding_constraint(p32, zsh)
                 np_, ns_ = opt_update(p32, g32, opt_state[n], lr, **opt_kwargs)
                 new_params[n] = np_.astype(t_params[n].dtype)
                 if n in master_names:
@@ -253,23 +357,6 @@ class ShardedTrainStep:
                 new_state[n] = ns_
             new_f = {n: aux.get(n, f_params[n]) for n in f_names}
             return new_params, new_f, new_master, new_state, loss_val
-
-        # shardings
-        mesh = self.mesh
-        repl = NamedSharding(mesh, P())
-        batch_sh = NamedSharding(mesh, P(self.dp_axis))
-
-        t_shardings = {n: NamedSharding(mesh, self._spec_for(n))
-                       for n in t_names}
-        f_shardings = {n: NamedSharding(mesh, self._spec_for(n))
-                       for n in f_names}
-        # optimizer state shards like its parameter
-        state_shardings = {
-            n: tuple((repl if s.ndim == 0 else t_shardings[n])
-                     for s in self._opt_state[n])
-            for n in t_names}
-
-        master_shardings = {n: t_shardings[n] for n in master_names}
         in_shardings = (t_shardings, f_shardings, master_shardings,
                         state_shardings,
                         tuple(batch_sh for _ in example_inputs),
@@ -290,6 +377,28 @@ class ShardedTrainStep:
         self._t_shardings = t_shardings
         self._f_shardings = f_shardings
         self._batch_sh = batch_sh
+        self._zero_shardings = zero_shardings
+        self._state_shardings = state_shardings
+        # Per-step collective accounting (mxnet_tpu_comm_* contract):
+        # ring-algorithm wire bytes per device, so ZeRO provably moves the
+        # SAME total as the replicated path — all_reduce(N) costs
+        # 2*(dp-1)/dp*N while reduce_scatter(N)+all_gather(N) cost
+        # (dp-1)/dp*N each. Analytic (XLA does not expose per-collective
+        # byte counters), recorded once per step in __call__.
+        dp = self._dp_size
+        ring = (dp - 1) / dp if dp > 1 else 0.0
+        plan = {}
+        for n, p in trainable:
+            size = int(onp.prod(p.data().shape)) if p.data().shape else 1
+            nbytes = size * jnp.dtype(p.data()._data.dtype).itemsize
+            if zero_specs[n] is not None:
+                for kind in ('reduce_scatter', 'all_gather'):
+                    b, c = plan.get(kind, (0.0, 0))
+                    plan[kind] = (b + ring * nbytes, c + 1)
+            elif dp > 1:
+                b, c = plan.get('all_reduce', (0.0, 0))
+                plan['all_reduce'] = (b + 2 * ring * nbytes, c + 1)
+        self._comm_plan = plan
 
     # ------------------------------------------------------------------
     def init(self, *example_inputs):
@@ -333,11 +442,17 @@ class ShardedTrainStep:
                                    self._master_shardings[n])
                 for n, p in self._trainable if n in self._master_names}
             self._opt_state = {
-                n: tuple(_put_replicated(
-                    s, NamedSharding(self.mesh, P()) if s.ndim == 0
-                    else self._t_shardings[n])
-                    for s in self._opt_state[n])
+                n: tuple(_put_replicated(s, sh) for s, sh in
+                         zip(self._opt_state[n], self._state_shardings[n]))
                 for n in self._t_names}
+            if self._pending_states is not None:
+                doc, self._pending_states = self._pending_states, None
+                self._apply_states(doc)
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.set_gauge(
+                    'mxnet_tpu_comm_opt_state_bytes_per_device',
+                    self.opt_state_bytes_per_device())
 
         t_params = {n: p.data()._data for n, p in self._trainable}
         f_params = {n: p.data()._data for n, p in self._frozen}
@@ -355,4 +470,80 @@ class ShardedTrainStep:
         self._master = new_master
         self._opt_state = new_state
         self._step_count += 1
+        if _telem['on'] and self._comm_plan:
+            from .. import telemetry as _telemetry
+            for kind, (nbytes, count) in self._comm_plan.items():
+                _telemetry.counter(
+                    'mxnet_tpu_comm_collective_bytes_total').inc(
+                        nbytes, kind=kind, axis=self.dp_axis)
+                _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
+                    count, kind=kind, axis=self.dp_axis)
         return NDArray(_local_value(loss))
+
+    # ------------------------------------------------------------------
+    # optimizer-state introspection + layout-independent checkpointing
+    # ------------------------------------------------------------------
+    def opt_state_bytes_per_device(self):
+        """Bytes of optimizer state (masters + moments) ONE device holds.
+        Under ZeRO-1 this is ~1/dp of the replicated footprint (± the
+        tensors too small/ragged to shard)."""
+        total = 0
+        for st in (self._opt_state or {}).values():
+            for s in st:
+                total += s.addressable_shards[0].data.nbytes
+        for m in (self._master or {}).values():
+            total += m.addressable_shards[0].data.nbytes
+        return total
+
+    def get_states_bytes(self):
+        """Optimizer state as a layout-independent bytes payload: every
+        shard is gathered to host fp32 numpy, so a checkpoint written at
+        one dp degree (or under ZeRO) restores at any other — the same
+        contract as gluon.Trainer.get_states_bytes, and what
+        checkpoint.CheckpointManager snapshots when bound as `trainer=`."""
+        import pickle
+        if self._compiled is None:
+            if self._pending_states is not None:
+                # resumed but not yet stepped (e.g. a preemption save in
+                # the restore->first-step window): the restored payload
+                # IS the current state — hand it back unchanged
+                return pickle.dumps(self._pending_states)
+            raise MXNetError("get_states_bytes: no optimizer state yet — "
+                             "run at least one step first")
+        states = {n: tuple(onp.asarray(s) for s in st)
+                  for n, st in self._opt_state.items()}
+        master = {n: onp.asarray(m) for n, m in self._master.items()}
+        return pickle.dumps({
+            'format': 'sharded_train_step_v1',
+            'opt_state': states, 'master': master,
+            'step_count': self._step_count,
+            'zero': self.zero, 'dp': self._dp_size})
+
+    def set_states_bytes(self, blob):
+        """Restore a get_states_bytes() payload, scattering each tensor
+        into THIS step's current layout (replicated, tp, or ZeRO 1/dp —
+        the saved layout does not have to match)."""
+        import pickle
+        doc = pickle.loads(blob)
+        if doc.get('format') != 'sharded_train_step_v1':
+            raise MXNetError(
+                f"set_states_bytes: not a ShardedTrainStep payload "
+                f"(format={doc.get('format')!r})")
+        if self._compiled is None:
+            self._pending_states = doc   # applied right after first build
+            return
+        self._apply_states(doc)
+
+    def _apply_states(self, doc):
+        for n, st in doc['opt_state'].items():
+            if n not in self._opt_state:
+                raise MXNetError(f"set_states_bytes: unknown parameter "
+                                 f"{n!r} in restored optimizer state")
+            self._opt_state[n] = tuple(
+                _put_replicated(onp.asarray(s), sh)
+                for s, sh in zip(st, self._state_shardings[n]))
+        for n, m in doc.get('master', {}).items():
+            if n in self._master_names:
+                self._master[n] = _put_replicated(
+                    onp.asarray(m), self._master_shardings[n])
+        self._step_count = int(doc.get('step_count', self._step_count))

@@ -62,6 +62,18 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_io_device_prefetch_depth': 'gauge',
         'mxnet_tpu_io_h2d_overlap_seconds_total': 'counter',
     },
+    'mxnet_tpu_comm_': {
+        # collective traffic accounting (ZeRO-1 / GSPMD dp path):
+        # ring-algorithm wire bytes per device by collective kind
+        # (reduce_scatter / all_gather / all_reduce / broadcast /
+        # state_scatter) and mesh axis — ZeRO must show the SAME total
+        # bytes as the replicated update while the optimizer-state gauge
+        # drops to ~1/dp
+        'mxnet_tpu_comm_collective_bytes_total': 'counter',
+        'mxnet_tpu_comm_collectives_total': 'counter',
+        # optimizer state (fp32 masters + moments) held by ONE device
+        'mxnet_tpu_comm_opt_state_bytes_per_device': 'gauge',
+    },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
         'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
