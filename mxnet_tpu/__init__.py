@@ -12,7 +12,7 @@ Usage mirrors MXNet:
         y = (x * 2).sum()
     y.backward()
 """
-from .base import MXNetError, __version__, register_op, list_ops
+from .base import MXNetError, DataError, __version__, register_op, list_ops
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus,
                       gpu_memory_info, current_context)
 from . import ops        # registers all operators
@@ -35,6 +35,7 @@ from . import gluon
 from . import profiler
 from . import telemetry
 from . import callback
+from . import resilience
 from . import checkpoint
 from . import runtime
 from . import config

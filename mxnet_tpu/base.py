@@ -18,6 +18,19 @@ class MXNetError(RuntimeError):
     """Default error type raised by the framework (ref: python/mxnet/base.py MXNetError)."""
 
 
+class DataError(MXNetError):
+    """A corrupt or truncated input record. Carries enough context to
+    act on (which record, at what file offset) instead of an opaque
+    struct/decode error that kills the epoch; the IO layer can also be
+    told to skip-and-count these (MXNET_TPU_IO_CORRUPT_POLICY=skip)."""
+
+    def __init__(self, message, index=None, offset=None, path=None):
+        super().__init__(message)
+        self.index = index
+        self.offset = offset
+        self.path = path
+
+
 # ---------------------------------------------------------------------------
 # Operator registry.
 #

@@ -7,6 +7,17 @@ import time
 from .base import telem_flags as _telem
 
 
+def prefix_arg_aux_params(arg_params, aux_params):
+    """The checkpoint key convention for symbolic-path params: one flat
+    dict keyed ``arg:<name>`` / ``aux:<name>``. Every site that saves
+    Module/symbolic params through a CheckpointManager (module_checkpoint,
+    do_checkpoint, BaseModule.fit's interrupt save) uses this helper so
+    the convention cannot drift between them."""
+    params = {f'arg:{k}': v for k, v in (arg_params or {}).items()}
+    params.update({f'aux:{k}': v for k, v in (aux_params or {}).items()})
+    return params
+
+
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
                       manager=None):
     """Epoch-end checkpoint callback for Module.
@@ -21,8 +32,7 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
         if (iter_no + 1) % period == 0:
             if manager is not None:
                 arg_params, aux_params = mod.get_params()
-                params = {f'arg:{k}': v for k, v in arg_params.items()}
-                params.update({f'aux:{k}': v for k, v in aux_params.items()})
+                params = prefix_arg_aux_params(arg_params, aux_params)
                 states = mod._updater.get_states(dump_optimizer=True) \
                     if save_optimizer_states and mod._updater is not None \
                     else None
@@ -38,6 +48,9 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
             else:
                 mod.save_checkpoint(prefix, iter_no + 1,
                                     save_optimizer_states)
+    # surfaced so BaseModule.fit can route its KeyboardInterrupt/SIGTERM
+    # final save through the same manager (resumable clean exit)
+    _callback.manager = manager
     return _callback
 
 
@@ -51,9 +64,7 @@ def do_checkpoint(prefix, period=1, manager=None):
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             if manager is not None:
-                params = {f'arg:{k}': v for k, v in (arg or {}).items()}
-                params.update(
-                    {f'aux:{k}': v for k, v in (aux or {}).items()})
+                params = prefix_arg_aux_params(arg, aux)
                 extra = {'symbol': sym.tojson().encode('utf-8')} \
                     if sym is not None else None
                 manager.save(iter_no + 1, params=params,
@@ -62,6 +73,7 @@ def do_checkpoint(prefix, period=1, manager=None):
             else:
                 from .model import save_checkpoint
                 save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    _callback.manager = manager
     return _callback
 
 

@@ -35,6 +35,9 @@ from typing import Any, Dict, Optional
 import numpy as onp
 
 from ..base import MXNetError, telem_flags as _telem
+from ..resilience import faults as _faults
+from ..resilience.faults import InjectedFault
+from ..resilience.retry import retry_call
 from . import manifest as mf
 from .manifest import CorruptCheckpointError
 
@@ -93,6 +96,15 @@ def _apply_params(target, loaded: Dict[str, onp.ndarray], strict: bool):
     """Write restored host arrays back into a params-like object."""
     from ..context import cpu
     from ..ndarray.ndarray import array
+    if callable(target) and not hasattr(target, 'items') \
+            and not hasattr(target, '_collect_params_with_prefix'):
+        # a zero-arg provider is snapshot-only: writing into the dict it
+        # RETURNS would be a silent no-op on the real model state
+        raise MXNetError(
+            "checkpoint restore: params are bound as a callable "
+            "provider, which only supports saving — restore with "
+            "apply=False and apply the arrays yourself (e.g. "
+            "Module.set_params)")
     if hasattr(target, '_collect_params_with_prefix'):
         target = target._collect_params_with_prefix()
     for name, p in target.items():
@@ -258,20 +270,26 @@ class CheckpointManager:
                              "maybe_save call to infer it from")
         self.save(step, block=True, **kwargs)
 
+    def save_due(self, step: int) -> bool:
+        """Would the autosave cadence save at `step`? (Factored out so
+        resilience.NonFiniteGuard.maybe_save can gate the actual save on
+        the step's non-finite flag without duplicating the cadence.)"""
+        if self.autosave_steps and step % self.autosave_steps == 0:
+            return True
+        if self.autosave_seconds is not None and \
+                _time.monotonic() - self._last_autosave_time \
+                >= self.autosave_seconds:
+            return True
+        if self.preempted and self.latest_step() != int(step):
+            return True
+        return False
+
     def maybe_save(self, step: int, metadata: Optional[dict] = None) -> bool:
         """Autosave cadence: call once per training step. Saves when the
         steps/seconds cadence fires (or a preemption signal arrived before
         the hook could save synchronously). Returns True when it saved."""
         self._current_step = int(step)
-        due = False
-        if self.autosave_steps and step % self.autosave_steps == 0:
-            due = True
-        if self.autosave_seconds is not None and \
-                _time.monotonic() - self._last_autosave_time \
-                >= self.autosave_seconds:
-            due = True
-        if self.preempted and self.latest_step() != int(step):
-            due = True
+        due = self.save_due(int(step))
         if due:
             self.save(step, metadata=metadata, block=self.preempted)
         return due
@@ -328,7 +346,15 @@ class CheckpointManager:
 
     def _write_and_commit(self, snap: dict, t_start: float) -> None:
         try:
-            total_bytes = self._write_step(snap)
+            # transient FS errors (and injected checkpoint.write raise
+            # faults) get a bounded retry: _write_step rebuilds its tmp
+            # dir from scratch every attempt, so a retry is idempotent
+            from .. import config as _config
+            total_bytes = retry_call(
+                self._write_step, snap,
+                retries=_config.get('MXTPU_CHECKPOINT_WRITE_RETRIES'),
+                retry_on=(OSError, InjectedFault),
+                site='checkpoint.write')
         except BaseException as e:  # surfaced on the training thread
             self._error = e
             # a failed same-step re-save may have retired the committed
@@ -350,6 +376,11 @@ class CheckpointManager:
 
     def _write_step(self, snap: dict) -> int:
         from ..serialization import save_ndarray_file
+        # fault site: 'raise' is retried by _write_and_commit as a
+        # transient FS error; 'corrupt' mangles the first payload's
+        # bytes AFTER hashing, producing a committed-but-invalid step
+        # that restore_latest() must fall back past
+        fault = _faults.fire('checkpoint.write')
         step = snap['step']
         final = self.step_dir(step)
         tmp = f'{final}.tmp-{os.getpid()}'
@@ -363,7 +394,10 @@ class CheckpointManager:
             rel = f'arrays/a{i:05d}.nd'
             payload = save_ndarray_file({name: arr})
             _run_hook('during_write', os.path.join(tmp, rel))
-            mf.write_bytes_durable(os.path.join(tmp, rel), payload)
+            written = payload
+            if fault == 'corrupt' and i == 0:
+                written = _faults.corrupt_bytes(payload)
+            mf.write_bytes_durable(os.path.join(tmp, rel), written)
             arr_entries.append({
                 'name': name, 'file': rel, 'bytes': len(payload),
                 'sha256': mf.sha256_bytes(payload),
@@ -538,13 +572,25 @@ class CheckpointManager:
                     f"{path}: content hash mismatch")
             return data
 
-        params = {}
-        for entry in doc.get('arrays', []):
-            arrays, names = load_ndarray_file(_read_verified(entry))
-            params[entry['name']] = arrays[0]
-        blobs = {entry['name']: _read_verified(entry)
-                 for entry in doc.get('blobs', [])}
-        return RestoredCheckpoint(doc['step'], d, params, blobs,
+        # a manifest that parsed as JSON can still be garbage (truncated
+        # then re-closed, bitrot inside a string, wrong-typed entries):
+        # every structural surprise below is a CORRUPT STEP — the caller
+        # (restore_latest) skips past it with a warning — never a raw
+        # KeyError/TypeError that aborts the whole restore scan
+        try:
+            params = {}
+            for entry in doc.get('arrays', []):
+                arrays, names = load_ndarray_file(_read_verified(entry))
+                params[entry['name']] = arrays[0]
+            blobs = {entry['name']: _read_verified(entry)
+                     for entry in doc.get('blobs', [])}
+            step_no = doc['step']
+        except CorruptCheckpointError:
+            raise
+        except Exception as e:
+            raise CorruptCheckpointError(
+                f"{d}: malformed manifest/payload structure: {e!r}")
+        return RestoredCheckpoint(step_no, d, params, blobs,
                                   doc.get('metadata', {}), doc.get('rng'))
 
     # -- preemption -------------------------------------------------------
@@ -552,10 +598,36 @@ class CheckpointManager:
     def install_preemption_hook(self, signals=(_signal.SIGTERM,)) -> None:
         """On each signal: synchronously commit a checkpoint at the
         current step, set ``self.preempted`` and chain any previous python
-        handler. The training loop should poll ``preempted`` and exit."""
+        handler. The training loop should poll ``preempted`` and exit.
+        Off the main thread (where CPython forbids signal handlers) this
+        warns and becomes a no-op instead of killing the training run."""
         for sig in signals:
-            old = _signal.signal(sig, self._on_signal)
+            try:
+                old = _signal.signal(sig, self._on_signal)
+            except ValueError:
+                warnings.warn(
+                    "checkpoint preemption hook not installed: signal "
+                    "handlers can only be set from the main thread — "
+                    "SIGTERM will not trigger save_now() in this run",
+                    RuntimeWarning)
+                return
             self._old_handlers.setdefault(sig, old)
+
+    @property
+    def hook_installed(self) -> bool:
+        """Whether a preemption signal hook is currently installed."""
+        return bool(self._old_handlers)
+
+    def bind_params(self, params) -> None:
+        """(Re)bind the params provider that save() snapshots: a Block,
+        ParameterDict, dict, or a zero-arg callable returning one (None
+        unbinds). Callable providers are snapshot-only — restore them
+        with ``apply=False``."""
+        self._params = params
+
+    @property
+    def params_bound(self) -> bool:
+        return self._params is not None
 
     def uninstall_preemption_hook(self) -> None:
         for sig, old in self._old_handlers.items():

@@ -167,6 +167,35 @@ register('MXNET_TPU_RECOMPILE_WARN_THRESHOLD', int, 3,
          'when one site, e.g. a hybridized block, compiles more than '
          'this many times — churning input shapes/dtypes force an XLA '
          'recompile every step.')
+register('MXTPU_FAULT', str, '',
+         'Arm deterministic fault injection: comma-separated '
+         'site:kind[:prob[:seed[:first-last]]] specs (kinds: raise, '
+         'hang, corrupt, nan). See mxnet_tpu.resilience.faults.sites() '
+         'for the registered sites. Read once at import; re-arm with '
+         'resilience.faults.arm_from_env().')
+register('MXTPU_FAULT_HANG_SECONDS', float, 300.0,
+         'How long an armed "hang" fault sleeps at its site (long '
+         'enough to trip the step watchdog, short enough for tests).')
+register('MXTPU_GUARD_MAX_BAD_STEPS', int, 3,
+         'NonFiniteGuard policy ladder: after this many CONSECUTIVE '
+         'non-finite steps (each already skipped on device), '
+         'auto-restore the newest committed checkpoint.')
+register('MXTPU_WATCHDOG_SECONDS', float, 300.0,
+         'StepWatchdog default deadline: with no training-step '
+         'heartbeat for this long, dump all-thread stacks + a telemetry '
+         'snapshot to the log (once per stall).')
+register('MXTPU_CHECKPOINT_WRITE_RETRIES', int, 2,
+         'Bounded retries (with backoff) of a checkpoint payload write '
+         'after a transient filesystem error before the failure '
+         'surfaces on the training thread.')
+register('MXTPU_DATALOADER_WORKER_RETRIES', int, 2,
+         'Bounded re-submissions of a gluon DataLoader batch fetch '
+         'after a worker crash before a clear error is raised.')
+register('MXNET_TPU_IO_CORRUPT_POLICY', str, 'error',
+         "What ImageRecordIter does with a corrupt/truncated record "
+         "mid-epoch: 'error' raises DataError naming the record index "
+         "and file offset; 'skip' substitutes the next good record and "
+         "counts mxnet_tpu_io_corrupt_records_total.")
 register('MXTPU_ZERO', _bool, True,
          'ZeRO-1 sharded optimizer update on the GSPMD data-parallel '
          'path: gradients reduce-scatter over the dp axis, each device '

@@ -223,6 +223,10 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
         self.manager = _checkpoint.CheckpointManager(
             self.model_dir, params=estimator.net, trainer=estimator.trainer,
             keep_last_n=max(1, self.max_checkpoints))
+        # SIGTERM (preemption) commits a synchronous checkpoint at the
+        # current step; the fit loop polls manager.preempted and exits
+        # cleanly with a "resumable from step N" message
+        self.manager.install_preemption_hook()
         if self.resume_from_checkpoint:
             self.resumed_step = self.manager.restore_latest()
             if self.resumed_step is not None:
@@ -250,9 +254,81 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
         self._last_saved_step = self.current_batch
         self.manager.save(self.current_batch, metadata=metadata)
 
+    def save_now(self):
+        """Synchronously commit a checkpoint at the current step (the
+        interrupt/preemption path). Returns the step saved, or None when
+        train_begin has not run yet."""
+        if self.manager is None:
+            return None
+        if self.manager.latest_step() != self.current_batch:
+            self.manager.save_now(self.current_batch)
+        self._last_saved_step = self.current_batch
+        return self.current_batch
+
     def train_end(self, estimator, *args, **kwargs):
         if self.manager is not None:
             self.manager.close()
+
+
+class WatchdogHandler(TrainBegin, BatchEnd, EpochBegin, EpochEnd,
+                      TrainEnd):
+    """Wires a ``resilience.StepWatchdog`` into the fit loop: one
+    heartbeat per batch (plus epoch boundaries, so checkpoint saves
+    between epochs don't read as stalls); when a step stalls past the
+    deadline the watchdog dumps all-thread stacks + a telemetry
+    snapshot to the log (and, with ``save_on_stall`` and a
+    CheckpointHandler present, attempts an emergency checkpoint through
+    its manager). Work that legitimately exceeds the deadline with no
+    batch_end in between — a long validation pass, or the FIRST step's
+    XLA trace+compile on a large model — needs a larger
+    ``deadline_seconds`` or its own ``watchdog.beat()`` calls: the
+    watchdog cannot see inside it and will report a (false) stall."""
+
+    def __init__(self, deadline_seconds=None, save_on_stall=False,
+                 on_stall=None):
+        self.deadline_seconds = deadline_seconds
+        self.save_on_stall = save_on_stall
+        self.on_stall = on_stall
+        self.watchdog = None
+        self._step = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from ...resilience import StepWatchdog
+        self._step = 0
+        self.watchdog = StepWatchdog(
+            deadline_seconds=self.deadline_seconds, manager=None,
+            save_on_stall=self.save_on_stall, on_stall=self.on_stall)
+        self.watchdog.start()
+
+    def _bind_manager(self, estimator):
+        # called by Estimator.fit right after every train_begin has run
+        # (a CheckpointHandler listed AFTER this handler creates its
+        # manager there) and BEFORE the first data fetch — the canonical
+        # stall — so save_on_stall works from the very first moment
+        if self.watchdog is not None and self.watchdog.manager is None:
+            for h in getattr(estimator, '_event_handlers', []):
+                if isinstance(h, CheckpointHandler) and \
+                        h.manager is not None:
+                    self.watchdog.manager = h.manager
+                    break
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._step += 1
+        if self.watchdog is not None:
+            self.watchdog.beat(self._step)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        if self.watchdog is not None:
+            self.watchdog.beat(self._step)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.watchdog is not None:
+            self.watchdog.beat(self._step)
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
@@ -370,36 +446,123 @@ class Estimator:
             raise MXNetError("Either epochs or batches must be specified")
         event_handlers = self._prepare_default_handlers(val_data,
                                                         event_handlers)
+        self._event_handlers = event_handlers
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
             train_end = self._categorize_handlers(event_handlers)
         self.stop_training = False
-        for handler in train_begin:
-            handler.train_begin(self)
-        while not self.stop_training:
-            for handler in epoch_begin:
-                handler.epoch_begin(self)
-            for batch in train_data:
-                data, label = self._get_data_and_label(batch, self.context,
-                                                       batch_axis)
-                batch_size = data[0].shape[batch_axis] * len(data)
-                for handler in batch_begin:
-                    handler.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = [self.net(x) for x in data]
-                    losses = [self.loss[0](yhat, y)
-                              for yhat, y in zip(pred, label)]
-                for l in losses:
-                    l.backward()
-                self.trainer.step(batch_size)
-                for handler in batch_end:
-                    handler.batch_end(self, batch=batch, pred=pred,
-                                      label=label, loss=losses)
-                if self.stop_training:
+        ckpt_handler = next((h for h in event_handlers
+                             if isinstance(h, CheckpointHandler)), None)
+        interrupted = None
+        begun = set()
+        try:
+            # inside the try: a later handler's train_begin raising must
+            # not leak what an earlier one installed (SIGTERM hook,
+            # watchdog thread)
+            for handler in train_begin:
+                handler.train_begin(self)
+                begun.add(id(handler))
+            # all managers exist now: bind them into any watchdog BEFORE
+            # the first data fetch (a hung first next(train_data) is the
+            # canonical stall, and save_on_stall must work for it)
+            for handler in event_handlers:
+                if isinstance(handler, WatchdogHandler):
+                    handler._bind_manager(self)
+            while not self.stop_training:
+                for handler in epoch_begin:
+                    handler.epoch_begin(self)
+                for batch in train_data:
+                    data, label = self._get_data_and_label(
+                        batch, self.context, batch_axis)
+                    batch_size = data[0].shape[batch_axis] * len(data)
+                    for handler in batch_begin:
+                        handler.batch_begin(self, batch=batch)
+                    with autograd.record():
+                        pred = [self.net(x) for x in data]
+                        losses = [self.loss[0](yhat, y)
+                                  for yhat, y in zip(pred, label)]
+                    for l in losses:
+                        l.backward()
+                    self.trainer.step(batch_size)
+                    for handler in batch_end:
+                        handler.batch_end(self, batch=batch, pred=pred,
+                                          label=label, loss=losses)
+                    if ckpt_handler is not None and \
+                            ckpt_handler.manager is not None and \
+                            ckpt_handler.manager.preempted:
+                        # SIGTERM: the preemption hook already committed
+                        # a synchronous checkpoint — exit the loop clean
+                        interrupted = 'SIGTERM'
+                        self.stop_training = True
+                    if self.stop_training:
+                        break
+                if interrupted is not None:
+                    # preemption: the grace window is for the final save,
+                    # not for epoch-end work (a ValidationHandler would
+                    # run a full eval pass here) — save first, exit clean
                     break
-            for handler in epoch_end:
-                handler.epoch_end(self)
-        for handler in train_end:
-            handler.train_end(self)
+                for handler in epoch_end:
+                    handler.epoch_end(self)
+        except KeyboardInterrupt:
+            # one final synchronous save + a clean, resumable exit —
+            # never a raw traceback mid-epoch
+            interrupted = 'KeyboardInterrupt'
+        except BaseException:
+            self._emergency_teardown(event_handlers, ckpt_handler)
+            raise
+        try:
+            if interrupted is not None:
+                self._report_interrupted(interrupted, ckpt_handler)
+            for handler in train_end:
+                # an interrupt during the train_begin phase leaves later
+                # handlers un-begun: their train_end would read state
+                # their train_begin never set
+                if isinstance(handler, TrainBegin) and \
+                        id(handler) not in begun:
+                    continue
+                handler.train_end(self)
+            if any(isinstance(h, TrainBegin) and id(h) not in begun
+                   for h in event_handlers):
+                # the interrupt landed INSIDE some train_begin: its
+                # train_end was skipped above, so whatever the partial
+                # train_begin already installed (SIGTERM hook, watchdog
+                # thread) must still be torn down
+                self._emergency_teardown(event_handlers, ckpt_handler)
+        except BaseException:
+            # a SECOND Ctrl-C during the final save / teardown must not
+            # leak either — same cleanup as an escaping training error
+            self._emergency_teardown(event_handlers, ckpt_handler)
+            raise
+
+    def _emergency_teardown(self, event_handlers, ckpt_handler):
+        """train_end never runs on an escaping error, so nothing
+        process-global may outlive fit: the SIGTERM handler (a later
+        signal would save stale state through the abandoned manager) and
+        any watchdog thread (its heartbeats stopped — it would keep
+        reporting false stalls forever)."""
+        if ckpt_handler is not None and ckpt_handler.manager is not None:
+            ckpt_handler.manager.uninstall_preemption_hook()
+        for h in event_handlers:
+            if isinstance(h, WatchdogHandler) and h.watchdog is not None:
+                h.watchdog.stop()
+                h.watchdog = None
+
+    def _report_interrupted(self, why, ckpt_handler):
+        log = logging.getLogger('estimator')
+        if ckpt_handler is None or ckpt_handler.manager is None:
+            log.warning(
+                'training interrupted (%s); no CheckpointHandler bound, '
+                'nothing saved — add one to make interrupts resumable',
+                why)
+            return
+        try:
+            step = ckpt_handler.save_now()
+            log.warning(
+                'training interrupted (%s); checkpoint committed — '
+                'resumable from step %s', why, step)
+        except Exception:
+            log.exception(
+                'training interrupted (%s) but the final checkpoint '
+                'save failed', why)
 
     def _prepare_default_handlers(self, val_data, event_handlers):
         event_handlers = list(event_handlers or [])

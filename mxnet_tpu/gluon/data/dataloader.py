@@ -14,7 +14,9 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
 
+from ...base import DataError, MXNetError, telem_flags as _telem
 from ...ndarray.ndarray import NDArray, array
+from ...resilience import faults as _faults
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 
@@ -39,9 +41,14 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 worker_retries=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        if worker_retries is None:
+            from ... import config as _config
+            worker_retries = _config.get('MXTPU_DATALOADER_WORKER_RETRIES')
+        self._worker_retries = max(0, int(worker_retries))
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "
@@ -81,10 +88,46 @@ class DataLoader:
         return self._pool
 
     def _fetch(self, batch):
+        _faults.fire('dataloader.worker')
         out = self._batchify_fn([self._dataset[idx] for idx in batch])
         if self._pin_memory:
             out = self._device_put(out)
         return out
+
+    def _result_with_respawn(self, future, batch, batch_idx):
+        """Surface a worker future's result; a crashed worker (any
+        exception) gets the batch re-submitted to the pool — the shared
+        ``resilience.retry_call`` bounded policy, counted in telemetry —
+        before a clear error names the batch that kept failing.
+        DataError (deterministic input corruption) propagates unchanged
+        and unretried so callers keep the index/offset/path context (the
+        iterator-level corrupt_policy stays the skip knob)."""
+        from ...resilience import retry_call
+        first = {'f': future}
+
+        def fetch_result():
+            f = first.pop('f', None)
+            if f is None:           # respawn: re-submit the same batch
+                if _telem['on']:
+                    from ... import telemetry as _telemetry
+                    _telemetry.inc(
+                        'mxnet_tpu_resilience_worker_respawns_total')
+                f = self._worker_pool().submit(self._fetch, batch)
+            return f.result()
+
+        try:
+            return retry_call(fetch_result, retries=self._worker_retries,
+                              backoff_seconds=0, retry_on=(Exception,),
+                              give_up_on=(DataError,),
+                              site='dataloader.worker')
+        except DataError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                f"DataLoader worker failed {self._worker_retries + 1}x "
+                f"on batch {batch_idx} (respawn budget "
+                f"{self._worker_retries} exhausted): "
+                f"{type(e).__name__}: {e}") from e
 
     @staticmethod
     def _device_put(out):
@@ -101,28 +144,30 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                out = self._batchify_fn(
-                    [self._dataset[idx] for idx in batch])
-                yield self._device_put(out) if self._pin_memory else out
+                # same fetch body as the worker path (incl. the
+                # dataloader.worker fault site), minus pool + respawn
+                yield self._fetch(batch)
             return
 
         pool = self._worker_pool()
         batches = list(self._batch_sampler)
         depth = max(1, self._prefetch)
         futures = []
-        it = iter(batches)
+        it = iter(enumerate(batches))
         for _ in range(depth):
             try:
-                futures.append(pool.submit(self._fetch, next(it)))
+                i, b = next(it)
+                futures.append((pool.submit(self._fetch, b), b, i))
             except StopIteration:
                 break
         while futures:
-            f = futures.pop(0)
+            f, b, i = futures.pop(0)
             try:
-                futures.append(pool.submit(self._fetch, next(it)))
+                j, nb = next(it)
+                futures.append((pool.submit(self._fetch, nb), nb, j))
             except StopIteration:
                 pass
-            yield f.result()
+            yield self._result_with_respawn(f, b, i)
 
     def close(self):
         if self._pool is not None:

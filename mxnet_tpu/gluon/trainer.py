@@ -12,6 +12,7 @@ from ..base import MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from ..resilience import faults as _faults
 from .parameter import ParameterDict, Parameter
 
 
@@ -52,6 +53,10 @@ class Trainer:
         # when the weights live on a >1-device dp mesh (see _zero_layout)
         self._zero_active = False
         self._zero_dp = 1
+        # resilience.NonFiniteGuard bound via attach_guard(): the fused
+        # update then also reduces isfinite over every gradient and
+        # skips the writeback ON DEVICE when the step is non-finite
+        self._guard = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -148,9 +153,73 @@ class Trainer:
                 # out of the histogram and the samples/sec + MFU gauges
         if not self._kv_initialized:
             self._init_kvstore()
+        kind = _faults.fire('step.dispatch')
+        if kind == 'nan':
+            self._poison_grads()
+        if self._guard is not None and \
+                self._guard.pre_step(on_bad=self._rewind_update_counts):
+            # a rollback just restored params/optimizer/RNG: the
+            # gradients sitting in the param buffers were computed
+            # against the pre-rollback weights — applying them would
+            # corrupt the freshly restored state, so this step's update
+            # is dropped and training resumes on the next batch
+            return
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def attach_guard(self, guard):
+        """Bind a ``resilience.NonFiniteGuard``. The fused update gains
+        an on-device all-gradients-finite reduction whose flag the guard
+        reads (deferred, no extra host sync) at the next step; a
+        non-finite step's weight/state writeback is skipped inside the
+        same XLA program. Forces a retrace (the guard changes the fused
+        program's signature)."""
+        self._guard = guard
+        self._fused_cache = None
+        self._fused_traced = False
+
+    def _poison_grads(self):
+        """Injected ``step.dispatch:nan`` fault: overwrite every gradient
+        with NaN on device, so the guard's detection/skip/rollback path
+        is exercised by a REAL non-finite step."""
+        for param in self._params:
+            if param.grad_req == 'null' or param._data is None:
+                continue
+            for g in param.list_grad():
+                g._data = g._data * float('nan')
+
+    def _guard_grads_ok(self, grads=None):
+        """Eager all-finite check (host sync — only for the paths that
+        cannot fuse the check into a compiled program: kvstore-side
+        updates and non-traceable optimizers). ``grads`` is an optional
+        iterable of gradient NDArrays; by default every parameter's
+        gradient copies are scanned."""
+        import jax.numpy as jnp
+        if grads is None:
+            grads = (g for param in self._params
+                     if param.grad_req != 'null' and param._data is not None
+                     for g in param.list_grad())
+        # reduce on device first: ONE host sync per step, not one per
+        # gradient
+        checks = [jnp.all(jnp.isfinite(g._data)) for g in grads]
+        if not checks:
+            return True
+        return bool(jnp.all(jnp.stack(checks)))
+
+    def _rewind_update_counts(self):
+        """A guard-skipped step was a device no-op, but the fused
+        dispatch advanced the host-side optimizer update counts before
+        the flag was known — rewind them so bias correction and
+        num_update-keyed LR schedules see the skip as a true no-op.
+        (The pjit ShardedTrainStep keeps t inside the where-gated
+        optimizer state, so only this path needs the rewind.)"""
+        snap = getattr(self, '_fused_count_snapshot', None)
+        if snap is not None:
+            counts, num = snap
+            self._optimizer._index_update_count = dict(counts)
+            self._optimizer.num_update = num
+            self._fused_count_snapshot = None
 
     def reset_step_timer(self):
         """Forget the previous step() timestamp so an intervening pause
@@ -207,6 +276,15 @@ class Trainer:
             if overflow:
                 return
         if self._update_on_kvstore and self._kvstore is not None:
+            if self._guard is not None:
+                # the update applies on the kvstore side, out of reach of
+                # the fused on-device gate — check eagerly BEFORE the
+                # push, or a NaN step poisons every replica
+                self._fused_count_snapshot = None   # nothing to rewind
+                ok = self._guard_grads_ok()
+                self._guard.push_flag(ok)
+                if not ok:
+                    return
             for i, param in enumerate(self._params):
                 if param.grad_req == 'null' or param._data is None:
                     continue
@@ -234,6 +312,15 @@ class Trainer:
         if self._fused_apply(items):
             pass
         else:
+            if self._guard is not None and items:
+                # eager fallback can't skip on device: check the grads
+                # up front (this path already syncs per parameter); the
+                # skip happens before any count advances — no rewind
+                self._fused_count_snapshot = None
+                ok = self._guard_grads_ok([g for _, _, g, _ in items])
+                self._guard.push_flag(ok)
+                if not ok:
+                    return
             for i, param, g, datas in items:
                 self._updater(i, g, datas[0])
         # broadcast the updated first copy to the other context copies
@@ -428,9 +515,11 @@ class Trainer:
                 return tuple(_reshape(x, leaves) for x in s)
             return s
 
+        guard_on = self._guard is not None
         sig = (tuple(indices), opt.__class__,
                tuple(d._data.dtype.name for _, _, _, ds in items
                      for d in ds[:1]),
+               guard_on,
                (self._zero_active, self._zero_dp))
         cache = getattr(self, '_fused_cache', None)
         if cache is None or cache[0] != sig:
@@ -439,7 +528,7 @@ class Trainer:
             self._zero_dp = zero['dp'] if zero else 1
             if zero is not None:
                 self._zero_place_states(items, zero)
-            sig = sig[:3] + ((self._zero_active, self._zero_dp),)
+            sig = sig[:4] + ((self._zero_active, self._zero_dp),)
             structs = [updater.states[i] for i in indices]
             zero_cache = zero
 
@@ -493,6 +582,21 @@ class Trainer:
                         opt.__dict__.pop(name, None)
                     opt._index_update_count = saved_count
                     opt.rescale_grad = saved_rescale
+                if guard_on:
+                    # non-finite guard, fused into THIS program: one
+                    # isfinite reduction over every raw gradient, and the
+                    # whole writeback gated on it — a NaN/Inf step keeps
+                    # the old weights and optimizer state on device; the
+                    # host reads the flag a step later (no extra sync)
+                    import functools as _functools
+                    ok = _functools.reduce(
+                        jnp.logical_and,
+                        [jnp.all(jnp.isfinite(g)) for g in grads])
+                    new_w = [jnp.where(ok, nw, w)
+                             for nw, w in zip(new_w, weights)]
+                    new_s = [jnp.where(ok, ns, s)
+                             for ns, s in zip(new_s, states_flat)]
+                    return new_w, new_s, ok
                 return new_w, new_s
 
             jit_kwargs = {}
@@ -502,8 +606,10 @@ class Trainer:
                 # then reuses the sharded buffers in place)
                 leaf_sh = [x.sharding for i in indices
                            for x in _flat(updater.states[i], [])]
-                jit_kwargs['out_shardings'] = (
-                    [s for s in zero_cache['w_sh']], leaf_sh)
+                out_sh = ([s for s in zero_cache['w_sh']], leaf_sh)
+                if guard_on:
+                    out_sh = out_sh + (zero_cache['repl'],)
+                jit_kwargs['out_shardings'] = out_sh
             jitted = jax.jit(fused, donate_argnums=(0, 2),
                              static_argnums=(6,), **jit_kwargs)
             self._fused_cache = (sig, fused, jitted)
@@ -515,8 +621,10 @@ class Trainer:
 
         # host-side per-step scalars (counts first, as the reference does);
         # snapshot them so a failed trace can roll back before the eager
-        # fallback re-counts
+        # fallback re-counts — and so the guard can rewind the advance
+        # if this step's flag comes back non-finite (device no-op)
         count_snapshot = (dict(opt._index_update_count), opt.num_update)
+        self._fused_count_snapshot = count_snapshot
         for i in indices:
             opt._update_count(i)
         lrs = jnp.asarray(opt._get_lrs(indices), jnp.float32)
@@ -565,8 +673,12 @@ class Trainer:
                 return False
         import time as _time
         t0 = _time.perf_counter()
-        new_w, new_s = jitted(weights, grads, states_flat, lrs,
-                              ts, rescale, wds)
+        out = jitted(weights, grads, states_flat, lrs, ts, rescale, wds)
+        if guard_on:
+            new_w, new_s, ok_flag = out
+            self._guard.push_flag(ok_flag)
+        else:
+            new_w, new_s = out
         if _telem['on'] and not was_traced:
             # first execution after a (re)trace: jit is lazy, so this is
             # where XLA actually compiles — account it as compile time

@@ -14,8 +14,9 @@ import queue as _queue
 
 import numpy as onp
 
-from ..base import MXNetError, telem_flags as _telem
+from ..base import DataError, MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray, array
+from ..resilience import faults as _faults
 
 
 # ---------------------------------------------------------------------------
@@ -61,6 +62,7 @@ def _device_put_batch(batch, ctx=None):
     is async: the host->device copy overlaps whatever the caller does
     next). Returns the same batch with device-committed arrays."""
     import jax
+    _faults.fire('io.device_put')
     dev = ctx.jax_device() if ctx is not None else None
 
     def put(x):
@@ -567,7 +569,7 @@ class ImageRecordIter(DataIter):
                  std_b=1.0, resize=-1, path_imgidx=None,
                  preprocess_threads=4, prefetch_buffer=4, seed=0,
                  transport=None, dtype='float32', decode_cache_mb=None,
-                 **kwargs):
+                 corrupt_policy=None, **kwargs):
         super().__init__(batch_size)
         self._rec_path = path_imgrec
         self.data_shape = tuple(data_shape)
@@ -594,11 +596,33 @@ class ImageRecordIter(DataIter):
             decode_cache_mb = float(os.environ.get(
                 'MXNET_TPU_IO_DECODE_CACHE_MB', '256'))
         self.decode_cache_mb = decode_cache_mb
+        if corrupt_policy is None:
+            from .. import config as _config
+            corrupt_policy = _config.get('MXNET_TPU_IO_CORRUPT_POLICY')
+        if corrupt_policy not in ('error', 'skip'):
+            raise MXNetError(f"corrupt_policy must be 'error' or 'skip', "
+                             f"got {corrupt_policy!r}")
+        self.corrupt_policy = corrupt_policy
         self._lease = None
         self._lease_consumer = None   # device array reading the lease
         self._cache_emitted = (0, 0)  # (hits, misses) already counted
         self._pipe = None
-        if self.data_shape[0] == 3:
+        # the per-record skip/substitute policy and the io.decode fault
+        # site live in the python decode path — the native pipeline
+        # surfaces a corrupt record as a hard DataError. Honor the
+        # requested semantics by downgrading to the python path (warned:
+        # it costs throughput) instead of silently ignoring the policy.
+        want_python = corrupt_policy == 'skip' or \
+            'io.decode' in _faults.active()
+        if want_python and self.data_shape[0] == 3:
+            import warnings
+            warnings.warn(
+                "ImageRecordIter: corrupt_policy='skip' (or an armed "
+                "io.decode fault) uses the pure-Python decode path — "
+                "the native pipeline cannot skip individual corrupt "
+                "records. Expect lower decode throughput.",
+                RuntimeWarning, stacklevel=2)
+        if self.data_shape[0] == 3 and not want_python:
             self._pipe = _NativePipeline.try_create(
                 path_imgrec, batch_size, self.data_shape, label_width,
                 preprocess_threads, prefetch_buffer, resize, shuffle,
@@ -648,14 +672,75 @@ class ImageRecordIter(DataIter):
 
     def _read_record(self, i):
         """(label, image bytes) for record i via positional read —
-        os.pread is thread-safe, no shared file-position state."""
+        os.pread is thread-safe, no shared file-position state. A
+        truncated or unpackable record raises DataError naming the
+        record index and file offset."""
         from .. import recordio
         pos, length = self._offsets[i]
         buf = os.pread(self._fd, length, pos)
         if len(buf) != length:
-            raise MXNetError(f"short read in {self._rec_path}")
-        header, img_bytes = recordio.unpack(buf)
+            raise DataError(
+                f"truncated record {i} at offset {pos} in "
+                f"{self._rec_path}: read {len(buf)} of {length} bytes",
+                index=i, offset=pos, path=self._rec_path)
+        try:
+            header, img_bytes = recordio.unpack(buf)
+        except Exception as e:
+            raise DataError(
+                f"corrupt record {i} at offset {pos} in "
+                f"{self._rec_path}: cannot unpack IRHeader: {e}",
+                index=i, offset=pos, path=self._rec_path)
         return header.label, img_bytes
+
+    def _load_and_decode(self, i):
+        """(label, decoded HWC image) for record i; every record-shaped
+        failure (truncation, bad header, undecodable image bytes)
+        surfaces as DataError with the record index + file offset."""
+        label, buf = self._read_record(i)
+        # keyed by record index, not call order: the decode thread pool
+        # must corrupt the same records in every run
+        if _faults.fire('io.decode', occurrence=i + 1) == 'corrupt':
+            buf = _faults.corrupt_bytes(buf, occurrence=i)
+        pos, _length = self._offsets[i]
+        try:
+            img = self._decode_image(buf)
+        except MXNetError:
+            raise        # environment problems (no PIL) are not DataErrors
+        except Exception as e:
+            raise DataError(
+                f"corrupt image in record {i} at offset {pos} in "
+                f"{self._rec_path}: {type(e).__name__}: {e}",
+                index=i, offset=pos, path=self._rec_path)
+        return label, img
+
+    def _load_with_policy(self, i, rnd):
+        """corrupt_policy='error': DataError propagates.
+        corrupt_policy='skip': each corrupt record is counted
+        (mxnet_tpu_io_corrupt_records_total) and the next readable
+        record is substituted — bounded, so a wholly-corrupt file still
+        fails loudly instead of spinning."""
+        j = i
+        for attempt in range(16):
+            try:
+                label, img = self._load_and_decode(j)
+                return label, self._augment(img, rnd)
+            except DataError as e:
+                if self.corrupt_policy != 'skip':
+                    raise
+                # the counter means "records silently substituted" (the
+                # documented dashboard semantics) — error-policy runs
+                # surface the DataError instead and count nothing
+                if _telem['on']:
+                    from .. import telemetry as _telemetry
+                    _telemetry.inc('mxnet_tpu_io_corrupt_records_total')
+                import logging
+                logging.getLogger('mxnet_tpu.io').warning(
+                    "skipping corrupt record (policy=skip): %s", e)
+                j = (j + 1) % len(self._offsets)
+        raise DataError(
+            f"{self._rec_path}: 16 consecutive corrupt records starting "
+            f"at index {i} — refusing to keep skipping "
+            f"(corrupt_policy='skip')", index=i, path=self._rec_path)
 
     def _decode_image(self, buf):
         import io as _io
@@ -739,6 +824,22 @@ class ImageRecordIter(DataIter):
 
     def iter_next(self):
         if self._pipe is not None:
+            # attribute check first, then the lock-free armed check —
+            # the steady-state per-batch cost is one getattr
+            if not getattr(self, '_warned_native_fault', False) and \
+                    _faults.is_armed('io.decode'):
+                # armed AFTER construction (construction-time arming
+                # selects the python path): the native pipeline has no
+                # per-record hook, so the fault cannot fire here — say
+                # so instead of letting a resilience test pass vacuously
+                self._warned_native_fault = True
+                import warnings
+                warnings.warn(
+                    "ImageRecordIter: an io.decode fault was armed "
+                    "after this iterator selected the native pipeline — "
+                    "the fault cannot fire on this path. Arm MXTPU_FAULT "
+                    "before constructing the iterator (it then uses the "
+                    "python decode path).", RuntimeWarning)
             # return the previous batch's lease only now: the consumer
             # has had a full step to materialize/device_put it, so the
             # zero-copy buffer was never read after release
@@ -825,8 +926,7 @@ class ImageRecordIter(DataIter):
 
         def work(args):
             i, rnd = args
-            label, buf = self._read_record(i)
-            return label, self._augment(self._decode_image(buf), rnd)
+            return self._load_with_policy(i, rnd)
 
         if self._decode_workers > 1 and len(idxs) > 1:
             if self._pool is None:
@@ -902,8 +1002,14 @@ class _NativePipeline:
                    output_u8)
 
     def _raise(self):
-        raise MXNetError("native pipeline: " +
-                         self._lib.mxt_pipeline_error(self._h).decode())
+        msg = self._lib.mxt_pipeline_error(self._h).decode()
+        low = msg.lower()
+        if any(k in low for k in ('record', 'decode', 'truncat', 'magic',
+                                  'corrupt')):
+            # record-shaped failures surface as DataError so callers can
+            # distinguish "this input file is damaged" from runtime bugs
+            raise DataError("native pipeline: " + msg)
+        raise MXNetError("native pipeline: " + msg)
 
     def next(self):
         """Copy-out path (f32 mode): (data NCHW f32, label

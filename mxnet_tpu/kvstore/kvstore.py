@@ -270,6 +270,8 @@ def _group(keys, values):
 def _reduce(vlist):
     """Sum device copies on the first copy's device (ref:
     CommDevice::Reduce, src/kvstore/comm.h:451 — gather-to-one then sum)."""
+    from ..resilience import faults as _faults
+    _faults.fire('collective.all_reduce')
     if len(vlist) == 1:
         return NDArray(vlist[0]._data)
     dev = list(vlist[0]._data.devices())[0]

@@ -83,8 +83,14 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """Training loop (ref: base_module.py:409)."""
+            monitor=None, sparse_row_id_fn=None, checkpoint_manager=None):
+        """Training loop (ref: base_module.py:409).
+
+        ``checkpoint_manager`` (or a ``callback.module_checkpoint(...,
+        manager=...)`` in ``epoch_end_callback``) makes interrupts
+        resumable: KeyboardInterrupt and SIGTERM commit one final
+        synchronous checkpoint and exit cleanly with a "resumable from
+        step N" message instead of a raw traceback."""
         assert num_epoch is not None, 'please specify number of epochs'
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
@@ -102,37 +108,143 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
-
-        for epoch in range(begin_epoch, num_epoch):
-            eval_metric.reset()
-            nbatch = 0
-            train_data.reset()
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if monitor is not None:
-                    monitor.toc_print()
-                self.update_metric(eval_metric, data_batch.label)
-                if batch_end_callback is not None:
-                    bec = BatchEndParam(epoch, nbatch, eval_metric)
-                    for cb in _as_list(batch_end_callback):
-                        cb(bec)
-                nbatch += 1
-            for name, val in eval_metric.get_name_value():
-                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
-            if epoch_end_callback is not None:
-                arg_params, aux_params = self.get_params()
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_params, aux_params)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info('Epoch[%d] Validation-%s=%f', epoch, name, val)
+        # explicit checkpoint_manager=: fit owns the save cadence and
+        # numbers steps in the BATCH domain. A manager discovered from a
+        # module_checkpoint callback already saves in the EPOCH domain
+        # (iter_no+1) — fit must not add batch-numbered saves into the
+        # same directory (retention sorts numerically; mixing domains
+        # would GC epoch saves and skew resume numbering), so it only
+        # polls preemption and reports on that manager.
+        mgr = checkpoint_manager
+        mgr_owns_cadence = checkpoint_manager is not None
+        if mgr is None and epoch_end_callback is not None:
+            for cb in _as_list(epoch_end_callback):
+                if getattr(cb, 'manager', None) is not None:
+                    mgr = cb.manager
+                    break
+        installed_hook = False
+        bound_params = False
+        if mgr is not None:
+            if not mgr.params_bound:
+                # Module managers are usually constructed params-unbound
+                # (callback.module_checkpoint passes arg:/aux: per save);
+                # bind a provider for the duration of fit so cadence
+                # saves and the SIGTERM hook commit REAL parameters, not
+                # empty checkpoints
+                def _module_params():
+                    from .callback import prefix_arg_aux_params
+                    return prefix_arg_aux_params(*self.get_params())
+                mgr.bind_params(_module_params)
+                bound_params = True
+            if not mgr.hook_installed:
+                mgr.install_preemption_hook()
+                installed_hook = mgr.hook_installed
+        # step numbering continues from the manager's newest committed
+        # checkpoint: a run resumed after an interrupt must not restart
+        # at 0, or its new checkpoints sort below the stale pre-resume
+        # ones and retention GCs the fresh progress first
+        global_step = (mgr.latest_step() or 0) if mgr_owns_cadence else 0
+        interrupted = None
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                eval_metric.reset()
+                nbatch = 0
+                train_data.reset()
+                for data_batch in train_data:
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    if monitor is not None:
+                        monitor.toc_print()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if batch_end_callback is not None:
+                        bec = BatchEndParam(epoch, nbatch, eval_metric)
+                        for cb in _as_list(batch_end_callback):
+                            cb(bec)
+                    nbatch += 1
+                    global_step += 1
+                    if mgr_owns_cadence:
+                        # advances the manager's step (so a SIGTERM save
+                        # lands on the right one) + autosave cadence
+                        mgr.maybe_save(global_step,
+                                       metadata={'epoch': epoch,
+                                                 'nbatch': nbatch})
+                    if mgr is not None and mgr.preempted:
+                        interrupted = 'SIGTERM'
+                        break
+                if interrupted:
+                    break
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info('Epoch[%d] Train-%s=%f', epoch, name,
+                                     val)
+                if epoch_end_callback is not None:
+                    arg_params, aux_params = self.get_params()
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_params, aux_params)
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info('Epoch[%d] Validation-%s=%f',
+                                         epoch, name, val)
+        except KeyboardInterrupt:
+            interrupted = 'KeyboardInterrupt'
+        finally:
+            # the final interrupt save below still needs the bound
+            # params provider — only the signal hook is torn down here;
+            # the provider is unbound at the very end of fit, or right
+            # now when an error is escaping (this finally is then the
+            # last fit code that runs)
+            if installed_hook:
+                mgr.uninstall_preemption_hook()
+            import sys as _sys
+            if bound_params and _sys.exc_info()[0] is not None:
+                mgr.bind_params(None)
+                bound_params = False
+        try:
+            if interrupted:
+                if mgr_owns_cadence and global_step:
+                    try:
+                        if mgr.latest_step() != global_step:
+                            mgr.save_now(global_step)
+                        self.logger.warning(
+                            'training interrupted (%s); checkpoint '
+                            'committed — resumable from step %d',
+                            interrupted, global_step)
+                    except Exception:
+                        self.logger.exception(
+                            'training interrupted (%s) but the final '
+                            'checkpoint save failed', interrupted)
+                elif mgr is not None:
+                    # callback-owned manager: its saves live in the
+                    # EPOCH domain — report what is committed, add
+                    # nothing
+                    latest = mgr.latest_step()
+                    if latest is not None:
+                        self.logger.warning(
+                            'training interrupted (%s); resumable from '
+                            'the checkpoint at step %d', interrupted,
+                            latest)
+                    else:
+                        self.logger.warning(
+                            'training interrupted (%s) before the first '
+                            'completed checkpoint; nothing saved',
+                            interrupted)
+                else:
+                    self.logger.warning(
+                        'training interrupted (%s) at step %d; no '
+                        'checkpoint manager bound, nothing saved',
+                        interrupted, global_step)
+        finally:
+            # a SECOND Ctrl-C during the final save must not escape with
+            # the temporary provider still bound (restore_latest through
+            # this manager would then refuse with the callable error)
+            if bound_params:
+                mgr.bind_params(None)
 
     @property
     def symbol(self):

@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError, state as _flags, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
+from ..resilience import faults as _faults
 from .. import random as _random
 from .mesh import default_mesh
 
@@ -164,7 +165,8 @@ class ShardedTrainStep:
 
     def __init__(self, block, loss_fn, optimizer='sgd', optimizer_params=None,
                  mesh=None, dp_axis='dp', param_specs=None, donate=True,
-                 grad_dtype=None, zero=None, compression_params=None):
+                 grad_dtype=None, zero=None, compression_params=None,
+                 guard=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -204,6 +206,12 @@ class ShardedTrainStep:
         self._compiled = None
         self._step_count = 0
         self._pending_states = None   # restored blob awaiting first build
+        # resilience.NonFiniteGuard: the pjit step then also reduces
+        # isfinite over loss + every grad and gates the whole writeback
+        # on device; the guard reads the flag one step deferred
+        self._guard = guard
+        if guard is not None:
+            guard.add_post_restore_hook(self._replace_params_on_mesh)
 
     # ------------------------------------------------------------------
     def _collect(self):
@@ -267,7 +275,8 @@ class ShardedTrainStep:
         opt_kwargs = self.optimizer_params
         n_inputs = len(example_inputs)
 
-        def forward_loss(t_params, f_params, inputs, labels, key):
+        def forward_loss(t_params, f_params, inputs, labels, key,
+                         fault_scale):
             all_params = dict(t_params)
             all_params.update(f_params)
             name_to_param = dict(trainable + frozen)
@@ -286,7 +295,12 @@ class ShardedTrainStep:
                 _flags.is_training = prev
                 for p in name_to_param.values():
                     p._clear_trace_proxy()
-            loss_val = jnp.mean(loss._data)
+            # fault_scale is 1.0 on every normal step (an exact-identity
+            # multiply); an injected step.dispatch:nan passes NaN here,
+            # poisoning the loss AND (via the chain rule) every gradient
+            # regardless of the model's input dtypes — int-token models
+            # like BERT included
+            loss_val = jnp.mean(loss._data) * fault_scale
             aux = {n: proxies[n]._data for n in f_names}
             return loss_val, aux
 
@@ -328,16 +342,21 @@ class ShardedTrainStep:
         shard_constraint = {n: zero_shardings[n] for n in t_names
                             if zero_specs[n] is not None}
 
+        guard_on = self._guard is not None
+
         def train_step(t_params, f_params, master, opt_state, inputs,
-                       labels, key, lr):
+                       labels, key, lr, fault_scale):
             (loss_val, aux), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(t_params, f_params, inputs,
-                                            labels, key)
+                                            labels, key, fault_scale)
             new_params = {}
             new_master = {}
             new_state = {}
+            ok = jnp.isfinite(loss_val) if guard_on else None
             for n in t_names:
                 g32 = grads[n].astype(jnp.float32)
+                if guard_on:
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g32)))
                 zsh = shard_constraint.get(n)
                 if zsh is not None:
                     # reduce-scatter: the grad is only ever consumed in
@@ -356,14 +375,33 @@ class ShardedTrainStep:
                     new_master[n] = np_
                 new_state[n] = ns_
             new_f = {n: aux.get(n, f_params[n]) for n in f_names}
+            if guard_on:
+                # non-finite guard fused into the pjit step: a bad step
+                # writes back the OLD params/master/state/aux on device —
+                # a no-op update inside the same XLA program, no host
+                # round-trip on the happy path
+                new_params = {n: jnp.where(ok, new_params[n], t_params[n])
+                              for n in t_names}
+                new_master = {n: jnp.where(ok, new_master[n], master[n])
+                              for n in new_master}
+                new_state = {
+                    n: tuple(jnp.where(ok, ns_, os_) for ns_, os_ in
+                             zip(new_state[n], opt_state[n]))
+                    for n in t_names}
+                new_f = {n: jnp.where(ok, new_f[n], f_params[n])
+                         for n in f_names}
+                return (new_params, new_f, new_master, new_state,
+                        loss_val, ok)
             return new_params, new_f, new_master, new_state, loss_val
         in_shardings = (t_shardings, f_shardings, master_shardings,
                         state_shardings,
                         tuple(batch_sh for _ in example_inputs),
                         tuple(batch_sh for _ in example_labels),
-                        repl, repl)
+                        repl, repl, repl)
         out_shardings = (t_shardings, f_shardings, master_shardings,
                          state_shardings, repl)
+        if guard_on:
+            out_shardings = out_shardings + (repl,)
         donate = (0, 2, 3) if self.donate else ()
         self._compiled = jax.jit(train_step, in_shardings=in_shardings,
                                  out_shardings=out_shardings,
@@ -411,6 +449,14 @@ class ShardedTrainStep:
             _flags.is_recording = rec
 
     def __call__(self, inputs, labels, lr=None):
+        if self._guard is not None:
+            # deferred read of the previous step's finiteness flag; a
+            # rollback restores params/states/RNG and the post-restore
+            # hook re-places them on the mesh — the CURRENT batch then
+            # trains against the restored weights (fwd+bwd happen below,
+            # after the restore, so nothing here is stale)
+            self._guard.pre_step()
+        fault = _faults.fire('step.dispatch')
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
@@ -419,6 +465,12 @@ class ShardedTrainStep:
                          for x in inputs)
         lab_datas = tuple(x._data if isinstance(x, NDArray) else x
                           for x in labels)
+        # 1.0 on normal steps (exact-identity multiply on the loss); an
+        # injected step.dispatch:nan flips it to NaN inside the compiled
+        # step, so loss AND every gradient go non-finite even for
+        # int-input models (BERT token ids)
+        fault_scale = jnp.asarray(
+            float('nan') if fault == 'nan' else 1.0, jnp.float32)
         if self._compiled is None:
             trainable, frozen = self._collect()
             if not trainable and not frozen:
@@ -460,9 +512,14 @@ class ShardedTrainStep:
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
         in_datas = tuple(_put_batch(x, self._batch_sh) for x in in_datas)
         lab_datas = tuple(_put_batch(x, self._batch_sh) for x in lab_datas)
-        new_t, new_f, new_master, new_state, loss = self._compiled(
+        out = self._compiled(
             t_params, f_params, self._master, self._opt_state, in_datas,
-            lab_datas, key, lr_val)
+            lab_datas, key, lr_val, fault_scale)
+        if self._guard is not None:
+            new_t, new_f, new_master, new_state, loss, ok = out
+            self._guard.push_flag(ok)
+        else:
+            new_t, new_f, new_master, new_state, loss = out
         for n, p in self._trainable:
             p.data()._data = new_t[n]
         for n, p in self._frozen:
@@ -479,6 +536,20 @@ class ShardedTrainStep:
                 _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
                     count, kind=kind, axis=self.dp_axis)
         return NDArray(_local_value(loss))
+
+    def _replace_params_on_mesh(self):
+        """After an external restore wrote host arrays into the
+        parameters (NonFiniteGuard rollback via CheckpointManager), put
+        them back on the mesh with the step's shardings — the compiled
+        step cannot consume cpu-committed arrays."""
+        if self._compiled is None:
+            return
+        for n, p in self._trainable:
+            p._data[0]._data = _put_replicated(
+                onp.asarray(p.data()._data), self._t_shardings[n])
+        for n, p in self._frozen:
+            p._data[0]._data = _put_replicated(
+                onp.asarray(p.data()._data), self._f_shardings[n])
 
     # ------------------------------------------------------------------
     # optimizer-state introspection + layout-independent checkpointing

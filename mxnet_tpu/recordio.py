@@ -13,7 +13,7 @@ import struct
 
 import numpy as onp
 
-from .base import MXNetError
+from .base import DataError, MXNetError
 
 _MAGIC = 0xced7230a
 
@@ -67,6 +67,7 @@ class MXRecordIO:
         else:
             self.handle = open(self.uri, 'wb' if self.writable else 'rb')
         self.is_open = True
+        self._read_count = 0   # sequential record index for error context
 
     def close(self):
         if not self.is_open:
@@ -112,6 +113,11 @@ class MXRecordIO:
 
     def seek(self, pos):
         assert not self.writable
+        # sequential record counting is meaningless after a random seek;
+        # None makes read()'s corrupt-record context say "record ?"
+        # instead of naming the WRONG record (MXIndexedRecordIO.read_idx
+        # fills in the real key)
+        self._read_count = None
         if self._native is not None:
             lib, h = self._native
             lib.mxt_recordio_reader_seek(h, pos)
@@ -137,6 +143,15 @@ class MXRecordIO:
         if pad:
             self.handle.write(b'\x00' * pad)
 
+    def _data_error(self, what, pos, detail=''):
+        # _read_count is None after a random seek (sequential index
+        # unknown) — say "record ?" rather than naming the wrong record
+        rec = self._read_count if self._read_count is not None else '?'
+        return DataError(
+            f"{what} in {self.uri} (record {rec} at offset {pos}"
+            + (f": {detail}" if detail else '') + ')',
+            index=self._read_count, offset=pos, path=self.uri)
+
     def read(self):
         assert not self.writable
         if self._native is not None:
@@ -147,19 +162,34 @@ class MXRecordIO:
             if n == -1:
                 return None
             if n < 0:
-                raise MXNetError(f"invalid record magic in {self.uri}")
+                # tell() only on the error path (a failed read does not
+                # advance past the bad record) — the happy path stays at
+                # one FFI call per record
+                raise self._data_error('invalid record magic',
+                                       lib.mxt_recordio_reader_tell(h))
+            if self._read_count is not None:
+                self._read_count += 1
             return ctypes.string_at(out, n)
+        pos = self.handle.tell()
         head = self.handle.read(8)
-        if len(head) < 8:
+        if not head:
             return None
+        if len(head) < 8:
+            raise self._data_error('truncated record header', pos)
         magic, lrec = struct.unpack('<II', head)
         if magic != _MAGIC:
-            raise MXNetError("invalid record magic")
+            raise self._data_error('invalid record magic', pos)
         _, length = _decode_lrec(lrec)
         buf = self.handle.read(length)
+        if len(buf) < length:
+            raise self._data_error(
+                'truncated record payload', pos,
+                f'read {len(buf)} of {length} bytes')
         pad = (4 - length % 4) % 4
         if pad:
             self.handle.read(pad)
+        if self._read_count is not None:
+            self._read_count += 1
         return buf
 
 
@@ -199,7 +229,14 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def read_idx(self, idx):
         self.seek(idx)
-        return self.read()
+        try:
+            return self.read()
+        except DataError as e:
+            # random access knows the real record key — restore the
+            # context the sequential counter lost at seek()
+            raise DataError(
+                f"record {idx!r} in {self.uri} (offset {e.offset}): {e}",
+                index=idx, offset=e.offset, path=self.uri) from e
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

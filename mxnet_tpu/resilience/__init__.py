@@ -1,0 +1,27 @@
+"""Training resilience: fault injection, non-finite guard, watchdog.
+
+The detection/recovery half of fault tolerance (checkpointing is the
+durability half, see ``mxnet_tpu.checkpoint``): deterministic fault
+injection so every recovery path is exercised by real failures in CI
+(``faults``), an on-device non-finite guard with skip-step and
+auto-rollback policies (``guard``), a heartbeat watchdog that dumps
+all-thread stacks when a step wedges (``watchdog``), and the shared
+bounded retry helper (``retry``).
+
+Arm faults with ``MXTPU_FAULT=site:kind[:prob[:seed[:first-last]]]``
+(see ``faults.sites()`` for the registered sites).
+"""
+from __future__ import annotations
+
+from . import faults
+from .faults import InjectedFault
+from .guard import NonFiniteGuard
+from .retry import retry_call
+from .watchdog import StepWatchdog, format_all_stacks
+
+__all__ = ['faults', 'InjectedFault', 'NonFiniteGuard', 'retry_call',
+           'StepWatchdog', 'format_all_stacks']
+
+# arm any sites named by the environment at import (the config var is
+# read through the declared registry; an empty/unset var arms nothing)
+faults.arm_from_env()

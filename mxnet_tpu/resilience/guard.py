@@ -1,0 +1,217 @@
+"""Non-finite guard: on-device detection, skip-step, auto-rollback.
+
+A single NaN step silently poisons a multi-hour run: the update applies,
+every parameter becomes NaN, and nothing downstream ever says so. The
+guard closes that hole in three layers:
+
+1. **On-device detection + skip (free-ish)**: the existing jitted step
+   (gluon ``Trainer``'s fused update, ``ShardedTrainStep``'s pjit step)
+   additionally reduces ``isfinite`` over the loss and every gradient
+   into one scalar flag, and gates the weight/optimizer-state outputs
+   with ``where(finite, new, old)`` — a non-finite step is a no-op ON
+   DEVICE, inside the same XLA program, before the host ever knows.
+2. **Deferred host check (no extra sync)**: the flag is a device scalar
+   the guard reads at the START of the next step, when the previous
+   step's program has long finished — the happy path never blocks on an
+   extra device->host sync.
+3. **Policy ladder**: each bad step counts
+   (``mxnet_tpu_resilience_bad_steps_total``); after
+   ``max_consecutive_bad`` (default ``MXTPU_GUARD_MAX_BAD_STEPS`` = 3)
+   consecutive bad steps the guard auto-restores the newest committed
+   checkpoint via ``CheckpointManager.restore_latest()`` — parameters,
+   optimizer state, RNG stream and LR-scheduler position — and training
+   continues from known-good state
+   (``mxnet_tpu_resilience_rollbacks_total`` /
+   ``_last_rollback_step`` / ``_recovery_seconds``).
+
+Usage::
+
+    mgr = checkpoint.CheckpointManager('ckpts/', params=net,
+                                       trainer=trainer, autosave_steps=50)
+    guard = resilience.NonFiniteGuard(manager=mgr)
+    trainer.attach_guard(guard)
+    for step in range(1, total + 1):
+        ... forward / backward ...
+        trainer.step(batch)          # on-device skip + flag for the guard
+        guard.observe_loss(loss)     # optional: fold loss finiteness in
+        guard.maybe_save(step)       # cadence save, gated on a good flag
+"""
+from __future__ import annotations
+
+import logging
+import time as _time
+
+from ..base import MXNetError, telem_flags as _telem
+
+__all__ = ['NonFiniteGuard']
+
+_log = logging.getLogger('mxnet_tpu.resilience')
+
+
+class NonFiniteGuard:
+    """Supervises one training loop. ``policy``:
+
+    - ``'rollback'`` (default): skip bad steps on device; after
+      ``max_consecutive_bad`` consecutive bad steps restore the newest
+      committed checkpoint (requires ``manager``).
+    - ``'skip'``: only skip (count forever, never restore).
+    - ``'raise'``: raise MXNetError after ``max_consecutive_bad``
+      consecutive bad steps (for jobs where a supervisor owns restarts).
+    """
+
+    def __init__(self, manager=None, max_consecutive_bad=None,
+                 policy='rollback'):
+        if policy not in ('rollback', 'skip', 'raise'):
+            raise MXNetError(
+                f"NonFiniteGuard policy must be 'rollback', 'skip' or "
+                f"'raise', got {policy!r}")
+        if policy == 'rollback' and manager is None:
+            raise MXNetError(
+                "NonFiniteGuard(policy='rollback') needs a "
+                "CheckpointManager to restore from; pass manager=... or "
+                "use policy='skip'")
+        if max_consecutive_bad is None:
+            from .. import config as _config
+            max_consecutive_bad = _config.get('MXTPU_GUARD_MAX_BAD_STEPS')
+        if int(max_consecutive_bad) < 1:
+            raise MXNetError("max_consecutive_bad must be >= 1")
+        self.manager = manager
+        self.max_consecutive_bad = int(max_consecutive_bad)
+        self.policy = policy
+        self.consecutive_bad = 0
+        self.bad_steps = 0
+        self.rollbacks = 0
+        self.last_rollback_step = None
+        self._pending = []          # device bool scalars (or host bools)
+        self._post_restore_hooks = []
+        self._save_deferred = False
+
+    # -- flag plumbing (called by Trainer / ShardedTrainStep) -------------
+
+    def push_flag(self, finite_flag):
+        """Record one step's on-device finiteness flag (a jax scalar or a
+        plain bool). Never blocks — the value is read at the next
+        ``pre_step()`` / ``maybe_save()``."""
+        self._pending.append(finite_flag)
+
+    def observe_loss(self, loss):
+        """Optionally fold a loss value's finiteness into the pending
+        flag set (a tiny on-device reduction, read deferred like every
+        other flag)."""
+        import jax.numpy as jnp
+        data = getattr(loss, '_data', loss)
+        self._pending.append(jnp.all(jnp.isfinite(
+            jnp.asarray(data, jnp.float32))))
+
+    def add_post_restore_hook(self, fn):
+        """Run ``fn()`` after every rollback restore (e.g. re-place
+        restored parameters onto a device mesh)."""
+        self._post_restore_hooks.append(fn)
+
+    def _drain(self):
+        """(any_flags, all_finite) over the pending flags; the host reads
+        here are of programs that finished a full step ago."""
+        if not self._pending:
+            return False, True
+        flags, self._pending = self._pending, []
+        return True, all(bool(f) for f in flags)
+
+    def peek_ok(self):
+        """All pending flags finite? (Reads without consuming: the bad
+        accounting in pre_step still sees them.) Forces a device sync —
+        only used on the sparse checkpoint cadence, never per step."""
+        return all(bool(f) for f in self._pending)
+
+    # -- per-step supervision ---------------------------------------------
+
+    def pre_step(self, on_bad=None):
+        """Call at the start of every training step. Reads the previous
+        step's flag and walks the policy ladder. Returns True when a
+        rollback just happened — the caller must treat any state computed
+        BEFORE the restore (e.g. gradients from backward) as stale and
+        skip applying it. ``on_bad`` (optional) runs once when the
+        drained flag was bad, before any rollback — callers use it to
+        undo host-side bookkeeping the skipped step already advanced
+        (e.g. optimizer update counts)."""
+        had, ok = self._drain()
+        if not had:
+            return False
+        if ok:
+            self.consecutive_bad = 0
+            return False
+        if on_bad is not None:
+            on_bad()
+        self.consecutive_bad += 1
+        self.bad_steps += 1
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_resilience_bad_steps_total')
+        _log.warning(
+            "non-finite training step detected (%d consecutive, "
+            "update skipped on device)", self.consecutive_bad)
+        if self.consecutive_bad < self.max_consecutive_bad:
+            return False
+        if self.policy == 'skip':
+            return False
+        if self.policy == 'raise':
+            raise MXNetError(
+                f"NonFiniteGuard: {self.consecutive_bad} consecutive "
+                f"non-finite steps (policy='raise')")
+        return self._rollback()
+
+    def _rollback(self):
+        t0 = _time.perf_counter()
+        self.consecutive_bad = 0
+        step = self.manager.restore_latest()
+        if step is None:
+            raise MXNetError(
+                "NonFiniteGuard: rollback triggered but no committed "
+                "checkpoint exists yet — save one before the first "
+                "divergence (autosave_steps) or lower "
+                "max_consecutive_bad")
+        for fn in self._post_restore_hooks:
+            fn()
+        self.rollbacks += 1
+        self.last_rollback_step = step
+        dt = _time.perf_counter() - t0
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_resilience_rollbacks_total')
+            _telemetry.set_gauge('mxnet_tpu_resilience_last_rollback_step',
+                                 step)
+            _telemetry.observe('mxnet_tpu_resilience_recovery_seconds', dt)
+        _log.warning(
+            "non-finite guard rolled back to checkpoint step %d "
+            "(%.3fs): params, optimizer state, RNG and LR schedule "
+            "restored", step, dt)
+        return True
+
+    # -- checkpoint gating --------------------------------------------------
+
+    def maybe_save(self, step, metadata=None):
+        """Cadence-gated save through the bound manager, additionally
+        gated on the current step's flag being finite — a checkpoint must
+        never capture the state of a step the guard is about to reject.
+        The flag read syncs, so this only happens when the manager's
+        autosave cadence is actually due. Returns True when it saved."""
+        mgr = self.manager
+        if mgr is None:
+            raise MXNetError("NonFiniteGuard.maybe_save needs a manager")
+        mgr._current_step = int(step)
+        if not mgr.save_due(int(step)) and not self._save_deferred:
+            return False
+        if not self.peek_ok() and not mgr.preempted:
+            # DEFER, don't drop: with a steps cadence the next due save
+            # would otherwise be a full interval away, doubling the
+            # worst-case rollback re-train exactly during NaN bursts.
+            # EXCEPT under preemption: every guard path skips a bad
+            # update before it applies, so the parameters are clean —
+            # the last-chance grace-window save must never be deferred.
+            self._save_deferred = True
+            _log.warning(
+                "deferring checkpoint at step %d: the step's non-finite "
+                "flag is set (saved at the next finite step)", step)
+            return False
+        self._save_deferred = False
+        mgr.save(int(step), metadata=metadata, block=mgr.preempted)
+        return True

@@ -1,0 +1,187 @@
+"""Step watchdog: detect a wedged training step and say WHY.
+
+A hung collective or a deadlocked input pipeline doesn't crash — it
+wedges. The process sits at 0% MFU forever and the only signal is the
+absence of log lines. The watchdog is a heartbeat-fed background thread:
+the training loop calls ``beat(step)`` once per step; when no beat
+arrives for ``deadline_seconds`` the watchdog dumps every thread's stack
+plus a telemetry snapshot to the log (so the post-mortem names the
+wedged frame, not just the wall-clock) and can optionally trigger the
+checkpoint manager's synchronous ``save_now()`` — the same path the
+SIGTERM preemption hook uses — so a supervisor can kill/restart the job
+without losing the step window.
+
+One dump per stall: the watchdog re-arms only after the next beat, so a
+wedge produces one actionable report, not a log flood.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time as _time
+import traceback
+
+from ..base import telem_flags as _telem
+
+__all__ = ['StepWatchdog', 'format_all_stacks']
+
+_log = logging.getLogger('mxnet_tpu.resilience')
+
+
+def format_all_stacks():
+    """One string with every live thread's name + current stack."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for ident, frame in sorted(frames.items()):
+        name = names.get(ident, '?')
+        stack = ''.join(traceback.format_stack(frame))
+        chunks.append(f"--- thread {name} (ident {ident}) ---\n{stack}")
+    return ''.join(chunks)
+
+
+class StepWatchdog:
+    """Heartbeat watchdog for a training loop.
+
+    ::
+
+        wd = resilience.StepWatchdog(deadline_seconds=120, manager=mgr,
+                                     save_on_stall=True)
+        with wd:
+            for step in ...:
+                ... train ...
+                wd.beat(step)
+
+    ``on_stall`` (optional callable ``fn(report_str)``) replaces the
+    default log dump — tests and custom supervisors hook in there.
+    ``save_on_stall`` attempts ``manager.save_now()`` from a separate
+    daemon thread (the stalled thread may hold the manager lock — the
+    attempt must never wedge the watchdog itself).
+    """
+
+    def __init__(self, deadline_seconds=None, poll_seconds=None,
+                 manager=None, save_on_stall=False, on_stall=None):
+        if deadline_seconds is None:
+            from .. import config as _config
+            deadline_seconds = _config.get('MXTPU_WATCHDOG_SECONDS')
+        self.deadline_seconds = float(deadline_seconds)
+        if self.deadline_seconds <= 0:
+            raise ValueError("watchdog deadline must be > 0 seconds")
+        self.poll_seconds = float(poll_seconds) if poll_seconds \
+            else max(0.05, self.deadline_seconds / 4.0)
+        self.manager = manager
+        self.save_on_stall = bool(save_on_stall)
+        self.on_stall = on_stall
+        self.stalls = 0
+        self.last_step = None
+        self._beat_time = None
+        self._dumped_since_beat = False
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._beat_time = _time.monotonic()
+        self._dumped_since_beat = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='mxtpu-step-watchdog')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.poll_seconds))
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def beat(self, step=None):
+        """The training loop made progress. Cheap: a timestamp + flag."""
+        with self._lock:
+            self._beat_time = _time.monotonic()
+            self._dumped_since_beat = False
+            if step is not None:
+                self.last_step = step
+
+    # -- the watchdog thread ----------------------------------------------
+
+    def _run(self):
+        while not self._stop.wait(self.poll_seconds):
+            with self._lock:
+                stalled = (not self._dumped_since_beat
+                           and self._beat_time is not None
+                           and _time.monotonic() - self._beat_time
+                           > self.deadline_seconds)
+                if stalled:
+                    self._dumped_since_beat = True
+                    age = _time.monotonic() - self._beat_time
+                    step = self.last_step
+            if stalled:
+                self._on_stall(age, step)
+
+    def _on_stall(self, age, step):
+        self.stalls += 1
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_resilience_watchdog_stalls_total')
+        report = self._format_report(age, step)
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except Exception:
+                _log.exception("watchdog on_stall callback failed")
+        else:
+            _log.error("%s", report)
+        if self.save_on_stall and self.manager is not None:
+            # separate thread: save_now serializes on the manager lock,
+            # which the wedged thread may hold — the watchdog must keep
+            # running (and keep reporting) regardless
+            threading.Thread(target=self._try_save, daemon=True,
+                             name='mxtpu-watchdog-save').start()
+
+    def _try_save(self):
+        try:
+            step = self.manager._current_step
+            if step is None:
+                # nothing has told the manager a step yet (e.g. a stall
+                # in the very first batch): fall back to the heartbeat
+                # step, or 0 — an initial-state checkpoint still beats
+                # losing the run
+                step = self.last_step if self.last_step is not None else 0
+            self.manager.save_now(step)
+            _log.warning("watchdog: emergency checkpoint committed at "
+                         "step %s", step)
+        except Exception:
+            _log.exception("watchdog: emergency save_now() failed")
+
+    def _format_report(self, age, step):
+        lines = [
+            f"watchdog: no training-step heartbeat for {age:.1f}s "
+            f"(deadline {self.deadline_seconds:.1f}s, last step "
+            f"{step if step is not None else 'unknown'}) — the step is "
+            f"stalled. All-thread stacks follow.",
+            format_all_stacks(),
+        ]
+        try:
+            from .. import telemetry as _telemetry
+            snap = _telemetry.report()
+            if snap:
+                lines.append(snap)
+        except Exception:
+            pass
+        return '\n'.join(lines)

@@ -233,6 +233,47 @@ def test_corrupt_latest_falls_back_to_previous(tmp_path):
         telemetry.reset()
 
 
+def test_truncated_manifest_is_skipped_with_warning(tmp_path):
+    """A manifest cut off mid-file (preempted writer, partial disk) is a
+    corrupt STEP — restore_latest() warns and falls back to the previous
+    committed step instead of dying on a JSON parse error."""
+    arrs = {'w': nd.array(onp.eye(3, dtype=onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs)
+    mgr.save(1, block=True)
+    arrs['w'] += 1
+    mgr.save(2, block=True)
+    man = str(tmp_path / 'step_0000000002' / 'manifest.json')
+    size = os.path.getsize(man)
+    with open(man, 'r+b') as fh:
+        fh.truncate(size // 2)            # mid-file: invalid JSON
+    with pytest.warns(RuntimeWarning, match='failed validation'):
+        ck = mgr.restore_latest(apply=False)
+    assert ck.step == 1
+    onp.testing.assert_array_equal(ck.params['w'],
+                                   onp.eye(3, dtype=onp.float32))
+    mgr.close()
+
+
+def test_garbage_manifest_json_is_skipped_with_warning(tmp_path):
+    """Valid JSON with a garbage structure (wrong-typed entries) must be
+    treated exactly like a hash mismatch: skip the step with a warning,
+    not a raw KeyError/TypeError aborting the restore scan."""
+    arrs = {'w': nd.array(onp.ones((2, 2), dtype=onp.float32))}
+    mgr = CheckpointManager(str(tmp_path), params=arrs)
+    mgr.save(1, block=True)
+    arrs['w'] += 3
+    mgr.save(2, block=True)
+    man = str(tmp_path / 'step_0000000002' / 'manifest.json')
+    with open(man, 'w') as fh:
+        # parses fine, but 'arrays' entries are not objects
+        fh.write('{"format_version": 1, "step": 2, '
+                 '"arrays": ["not", "entries"], "blobs": []}')
+    with pytest.warns(RuntimeWarning, match='failed validation'):
+        ck = mgr.restore_latest(apply=False)
+    assert ck.step == 1
+    mgr.close()
+
+
 def test_all_corrupt_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path),
                             params={'w': nd.ones((2, 2))})

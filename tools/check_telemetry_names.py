@@ -61,6 +61,25 @@ SUBSYSTEM_METRICS = {
         # copy had to overlap compute in
         'mxnet_tpu_io_device_prefetch_depth': 'gauge',
         'mxnet_tpu_io_h2d_overlap_seconds_total': 'counter',
+        # corrupt/truncated records silently substituted under
+        # MXNET_TPU_IO_CORRUPT_POLICY=skip (error-policy raises
+        # DataError and counts nothing)
+        'mxnet_tpu_io_corrupt_records_total': 'counter',
+    },
+    'mxnet_tpu_resilience_': {
+        # fault injection: every armed-site firing, by site + kind
+        'mxnet_tpu_resilience_faults_injected_total': 'counter',
+        # bounded retry/backoff helper (checkpoint writes, ...), by site
+        'mxnet_tpu_resilience_retries_total': 'counter',
+        # non-finite guard: bad (skipped-on-device) steps, rollbacks to
+        # the last committed checkpoint, and how long recovery took
+        'mxnet_tpu_resilience_bad_steps_total': 'counter',
+        'mxnet_tpu_resilience_rollbacks_total': 'counter',
+        'mxnet_tpu_resilience_last_rollback_step': 'gauge',
+        'mxnet_tpu_resilience_recovery_seconds': 'histogram',
+        # step watchdog stall dumps and DataLoader worker respawns
+        'mxnet_tpu_resilience_watchdog_stalls_total': 'counter',
+        'mxnet_tpu_resilience_worker_respawns_total': 'counter',
     },
     'mxnet_tpu_comm_': {
         # collective traffic accounting (ZeRO-1 / GSPMD dp path):
