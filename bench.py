@@ -336,6 +336,50 @@ def _io_report(n_images=384, src_hw=(360, 480), out_hw=224):
                 "ref_baseline_images_per_sec": 3000}
 
 
+def _attribution_report(step, model, run_step, flops, peak_total,
+                        steps=8):
+    """Per-step attribution (ISSUE 6): arm span tracing, run a few
+    synced steps, and decompose wall time into input / h2d / compute /
+    collective / host-sync buckets joined with XLA cost_analysis — so
+    BENCH_r06+ carries fractions, not just img/s and step ms.
+
+    When the run itself was launched with MXTPU_TRACE=1, also save one
+    checkpoint inside the traced window (covering the checkpoint.*
+    spans) and leave `bench_trace.json` behind — a single
+    chrome://tracing-loadable timeline of the whole traced segment.
+    """
+    from mxnet_tpu import config as _mxcfg
+    from mxnet_tpu.telemetry import attribution, flight, trace
+
+    armed_by_env = _mxcfg.get('MXTPU_TRACE')
+    trace.enable()
+    flight.get().clear()
+    for _ in range(steps):
+        run_step()
+    if armed_by_env:
+        import tempfile
+        from mxnet_tpu.checkpoint import CheckpointManager
+        with tempfile.TemporaryDirectory() as td:
+            mgr = CheckpointManager(td, params=model, async_save=False)
+            mgr.save(steps)
+    comm_plan = getattr(step, '_comm_plan', None) or {}
+    rep = attribution.report(
+        flight.get().steps(), flops_per_step=flops,
+        peak_flops=peak_total,
+        collective_bytes={k: v[0] for k, v in comm_plan.items()})
+    xla = step.cost_analysis()
+    if xla:
+        rep['xla_cost_per_step'] = xla
+    rep['subsystems'] = attribution.subsystems(
+        {e['name'] for e in trace.chrome_events()}
+        | {n for r in flight.get().steps() for n in r['spans_ms']})
+    if armed_by_env:
+        rep['trace_dump'] = trace.dump('bench_trace.json')
+    else:
+        trace.disable()
+    return rep
+
+
 # ---------------------------------------------------------------------------
 # measurement child
 # ---------------------------------------------------------------------------
@@ -509,6 +553,19 @@ def _child(mode: str) -> None:
         except Exception as e:
             out["io"] = {"error": repr(e)[:300]}
             _log(f"io report failed: {e!r}")
+    # attribution LAST: with MXTPU_TRACE=1 the whole child traced from
+    # import, so the dumped timeline also carries the io report's spans
+    try:
+        peak_total = _peak_flops(devices[0]) * len(devices) if on_accel \
+            else None
+        out["attribution"] = _attribution_report(
+            step, model,
+            lambda: float(step(inputs, [labels, nsp]).asnumpy()),
+            flops, peak_total)
+        _log(f"attribution: {out['attribution']}")
+    except Exception as e:
+        out["attribution"] = {"error": repr(e)[:300]}
+        _log(f"attribution report failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 

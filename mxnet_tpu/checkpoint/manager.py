@@ -36,6 +36,7 @@ import numpy as onp
 
 from ..base import MXNetError, telem_flags as _telem
 from ..resilience import faults as _faults
+from ..telemetry import trace as _trace
 from ..resilience.faults import InjectedFault
 from ..resilience.retry import retry_call
 from . import manifest as mf
@@ -239,8 +240,9 @@ class CheckpointManager:
             self._reraise_write_error()
             self._in_save = True
             try:
-                snapshot = self._snapshot(step, params, states, metadata,
-                                          extra_blobs)
+                with _trace.span('checkpoint.snapshot', step=int(step)):
+                    snapshot = self._snapshot(step, params, states,
+                                              metadata, extra_blobs)
                 if self.async_save and not block:
                     t = threading.Thread(
                         target=self._write_and_commit,
@@ -350,11 +352,12 @@ class CheckpointManager:
             # faults) get a bounded retry: _write_step rebuilds its tmp
             # dir from scratch every attempt, so a retry is idempotent
             from .. import config as _config
-            total_bytes = retry_call(
-                self._write_step, snap,
-                retries=_config.get('MXTPU_CHECKPOINT_WRITE_RETRIES'),
-                retry_on=(OSError, InjectedFault),
-                site='checkpoint.write')
+            with _trace.span('checkpoint.write', step=snap['step']):
+                total_bytes = retry_call(
+                    self._write_step, snap,
+                    retries=_config.get('MXTPU_CHECKPOINT_WRITE_RETRIES'),
+                    retry_on=(OSError, InjectedFault),
+                    site='checkpoint.write')
         except BaseException as e:  # surfaced on the training thread
             self._error = e
             # a failed same-step re-save may have retired the committed
@@ -530,7 +533,8 @@ class CheckpointManager:
                 restore_rng: bool = True):
         """Restore one committed step (hash-verified). See restore_latest."""
         t0 = _time.perf_counter()
-        ck = self._load_step(step)
+        with _trace.span('checkpoint.restore', step=int(step)):
+            ck = self._load_step(step)
         if apply:
             target = self._params
             if target is not None:

@@ -162,6 +162,23 @@ register('MXNET_TPU_TELEMETRY', _bool, False,
          'op-dispatch/compile/kvstore/IO/step metrics with Prometheus, '
          'JSON and chrome-trace export. Off: instrumented paths take a '
          'single flag-check fast path.')
+register('MXTPU_TRACE', _bool, False,
+         'Enable step-level span tracing (mxnet_tpu.telemetry.trace): '
+         'nested chrome-trace B/E spans over the step lifecycle (io, '
+         'h2d, dispatch, collectives, optimizer, checkpoint) in '
+         'lock-free per-thread ring buffers, plus the crash-time '
+         'flight recorder. Off: every span site takes a single '
+         'flag-check fast path and allocates nothing.')
+register('MXTPU_TRACE_RING', int, 16384,
+         'Span-trace ring capacity in events PER THREAD. A full ring '
+         'overwrites its oldest events (dropped whole spans are '
+         'counted in mxnet_tpu_trace_dropped_spans_total).')
+register('MXTPU_FLIGHT_STEPS', int, 64,
+         'Flight recorder depth: per-step span summaries (+ loss and '
+         'guard flags) retained for the crash-time dump.')
+register('MXTPU_FLIGHT_PATH', str, 'mxtpu_flight.json',
+         'Where the flight recorder writes its post-mortem JSON '
+         '(watchdog stall, guard rollback, atexit/fatal-signal hook).')
 register('MXNET_TPU_RECOMPILE_WARN_THRESHOLD', int, 3,
          'Telemetry recompile detector: warn (once per compile site) '
          'when one site, e.g. a hybridized block, compiles more than '

@@ -17,6 +17,7 @@ import numpy as onp
 from ...base import DataError, MXNetError, telem_flags as _telem
 from ...ndarray.ndarray import NDArray, array
 from ...resilience import faults as _faults
+from ...telemetry import trace as _trace
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 
@@ -88,10 +89,14 @@ class DataLoader:
         return self._pool
 
     def _fetch(self, batch):
-        _faults.fire('dataloader.worker')
-        out = self._batchify_fn([self._dataset[idx] for idx in batch])
-        if self._pin_memory:
-            out = self._device_put(out)
+        # worker-thread span: overlapped work, reported in the span
+        # table but excluded from attribution's wall-time buckets
+        with _trace.span('io.worker_fetch', batch_len=len(batch)):
+            _faults.fire('dataloader.worker')
+            out = self._batchify_fn([self._dataset[idx] for idx in batch])
+            if self._pin_memory:
+                with _trace.span('h2d.pin'):
+                    out = self._device_put(out)
         return out
 
     def _result_with_respawn(self, future, batch, batch_idx):
@@ -116,10 +121,13 @@ class DataLoader:
             return f.result()
 
         try:
-            return retry_call(fetch_result, retries=self._worker_retries,
-                              backoff_seconds=0, retry_on=(Exception,),
-                              give_up_on=(DataError,),
-                              site='dataloader.worker')
+            # consumer-side wait on the worker future: input-bound time
+            with _trace.span('io.wait'):
+                return retry_call(fetch_result,
+                                  retries=self._worker_retries,
+                                  backoff_seconds=0, retry_on=(Exception,),
+                                  give_up_on=(DataError,),
+                                  site='dataloader.worker')
         except DataError:
             raise
         except Exception as e:

@@ -13,6 +13,7 @@ from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..resilience import faults as _faults
+from ..telemetry import trace as _trace, flight as _flight
 from .parameter import ParameterDict, Parameter
 
 
@@ -153,20 +154,25 @@ class Trainer:
                 # out of the histogram and the samples/sec + MFU gauges
         if not self._kv_initialized:
             self._init_kvstore()
-        kind = _faults.fire('step.dispatch')
-        if kind == 'nan':
-            self._poison_grads()
-        if self._guard is not None and \
-                self._guard.pre_step(on_bad=self._rewind_update_counts):
-            # a rollback just restored params/optimizer/RNG: the
-            # gradients sitting in the param buffers were computed
-            # against the pre-rollback weights — applying them would
-            # corrupt the freshly restored state, so this step's update
-            # is dropped and training resumes on the next batch
-            return
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with _trace.span('step.dispatch'):
+            kind = _faults.fire('step.dispatch')
+            if kind == 'nan':
+                self._poison_grads()
+            if self._guard is not None and \
+                    self._guard.pre_step(on_bad=self._rewind_update_counts):
+                # a rollback just restored params/optimizer/RNG: the
+                # gradients sitting in the param buffers were computed
+                # against the pre-rollback weights — applying them would
+                # corrupt the freshly restored state, so this step's
+                # update is dropped and training resumes on the next
+                # batch
+                return
+            self._optimizer.rescale_grad = self._scale / batch_size
+            with _trace.span('comm.allreduce'):
+                self._allreduce_grads()
+            with _trace.span('optimizer.update'):
+                self._update(ignore_stale_grad)
+        _flight.record_step(self._optimizer.num_update)
 
     def attach_guard(self, guard):
         """Bind a ``resilience.NonFiniteGuard``. The fused update gains
@@ -336,8 +342,9 @@ class Trainer:
                 srcs.append(src)
                 shards.append(d._data.sharding)
         if dsts:
-            for d, out in zip(dsts, jax.device_put(srcs, shards)):
-                d._data = out
+            with _trace.span('comm.broadcast'):
+                for d, out in zip(dsts, jax.device_put(srcs, shards)):
+                    d._data = out
             if _telem['on']:
                 from .. import telemetry as _telemetry
                 _telemetry.counter(
@@ -673,7 +680,9 @@ class Trainer:
                 return False
         import time as _time
         t0 = _time.perf_counter()
-        out = jitted(weights, grads, states_flat, lrs, ts, rescale, wds)
+        with _trace.span('optimizer.fused'):
+            out = jitted(weights, grads, states_flat, lrs, ts, rescale,
+                         wds)
         if guard_on:
             new_w, new_s, ok_flag = out
             self._guard.push_flag(ok_flag)

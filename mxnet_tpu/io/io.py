@@ -17,6 +17,7 @@ import numpy as onp
 from ..base import DataError, MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray, array
 from ..resilience import faults as _faults
+from ..telemetry import trace as _trace
 
 
 # ---------------------------------------------------------------------------
@@ -72,10 +73,11 @@ def _device_put_batch(batch, ctx=None):
             return NDArray(data)
         return x
 
-    if batch.data is not None:
-        batch.data = [put(d) for d in batch.data]
-    if batch.label is not None:
-        batch.label = [put(l) for l in batch.label]
+    with _trace.span('h2d.device_put'):
+        if batch.data is not None:
+            batch.data = [put(d) for d in batch.data]
+        if batch.label is not None:
+            batch.label = [put(l) for l in batch.label]
     return batch
 
 
@@ -129,12 +131,18 @@ class DataIter:
 
     def __next__(self):
         if not _telem['on']:
-            return self.next()
+            # consumer-side input wait: the 'io.batch' span is the
+            # input-bound bucket in telemetry.attribution (self time —
+            # nested h2d/prefetch spans are credited to their own
+            # buckets). Disarmed: one flag check inside span().
+            with _trace.span('io.batch'):
+                return self.next()
         # batch-latency histogram: time the host side of producing one
         # batch (decode/augment/copy), the IO half of any input stall
         from .. import telemetry as _telemetry
         t0 = _time.perf_counter()
-        batch = self.next()
+        with _trace.span('io.batch'):
+            batch = self.next()
         _telemetry.observe('mxnet_tpu_io_batch_latency_seconds',
                            _time.perf_counter() - t0)
         _telemetry.inc('mxnet_tpu_io_batches_total')
@@ -378,7 +386,8 @@ class PrefetchingIter(DataIter):
             # for the end-of-epoch sentinel is not a miss: a pipeline
             # that kept up perfectly still ends every epoch on one.
             t0 = _time.perf_counter()
-            batch = self._queue.get()
+            with _trace.span('io.prefetch_wait'):
+                batch = self._queue.get()
             if batch is not None:
                 from .. import telemetry as _telemetry
                 _telemetry.inc('mxnet_tpu_io_prefetch_miss_total')
@@ -386,7 +395,8 @@ class PrefetchingIter(DataIter):
                     'mxnet_tpu_io_prefetch_stall_seconds_total').inc(
                     _time.perf_counter() - t0)
         else:
-            batch = self._queue.get()
+            with _trace.span('io.prefetch_wait'):
+                batch = self._queue.get()
         if batch is None:
             raise StopIteration
         if isinstance(batch, BaseException):
@@ -772,7 +782,8 @@ class ImageRecordIter(DataIter):
         # to run, so this sync is ~free in steady state.
         if self._lease_consumer is not None:
             try:
-                self._lease_consumer.block_until_ready()
+                with _trace.span('sync.lease_drain'):
+                    self._lease_consumer.block_until_ready()
             except Exception:
                 pass
             self._lease_consumer = None
@@ -845,7 +856,8 @@ class ImageRecordIter(DataIter):
             # zero-copy buffer was never read after release
             self._return_lease()
             if self.transport == 'u8':
-                got = self._pipe.next_lease()
+                with _trace.span('io.lease'):
+                    got = self._pipe.next_lease()
                 if got is None:
                     self._batch_data = None
                     self._emit_cache_stats()
@@ -853,7 +865,8 @@ class ImageRecordIter(DataIter):
                 data, label, count, lease_id = got
                 self._lease = lease_id
             else:
-                got = self._pipe.next()
+                with _trace.span('io.lease'):
+                    got = self._pipe.next()
                 if got is None:
                     self._batch_data = None
                     self._emit_cache_stats()
@@ -912,7 +925,8 @@ class ImageRecordIter(DataIter):
             if self.transport == 'u8':
                 fn = _device_normalize_fn(
                     self.mean.reshape(3), self.std.reshape(3), self.dtype)
-                out = fn(self._batch_data, onp.int32(self._count))
+                with _trace.span('h2d.normalize'):
+                    out = fn(self._batch_data, onp.int32(self._count))
                 self._lease_consumer = out
                 return [NDArray(out)]
             return [array(self._batch_data)]
@@ -928,15 +942,18 @@ class ImageRecordIter(DataIter):
             i, rnd = args
             return self._load_with_policy(i, rnd)
 
-        if self._decode_workers > 1 and len(idxs) > 1:
-            if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._pool = ThreadPoolExecutor(
-                    max_workers=self._decode_workers,
-                    thread_name_prefix='mxtpu-io-decode')
-            results = list(self._pool.map(work, zip(idxs, rnds)))
-        else:
-            results = [work(a) for a in zip(idxs, rnds)]
+        # one span for the whole batch decode (consumer blocks on the
+        # pool here — per-record spans in the workers would be noise)
+        with _trace.span('io.decode', records=len(idxs)):
+            if self._decode_workers > 1 and len(idxs) > 1:
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=self._decode_workers,
+                        thread_name_prefix='mxtpu-io-decode')
+                results = list(self._pool.map(work, zip(idxs, rnds)))
+            else:
+                results = [work(a) for a in zip(idxs, rnds)]
 
         labels = [lab for lab, _ in results]
         batch = [img for _, img in results]
@@ -951,7 +968,8 @@ class ImageRecordIter(DataIter):
             self._count_host_bytes(stacked.nbytes)
             fn = _device_normalize_fn(
                 self.mean.reshape(3), self.std.reshape(3), self.dtype)
-            return [NDArray(fn(stacked, onp.int32(self._count)))]
+            with _trace.span('h2d.normalize'):
+                return [NDArray(fn(stacked, onp.int32(self._count)))]
         out = onp.stack([self._host_normalize(im) for im in batch])
         # pad rows are exact zeros on every path (u8 masks on device)
         if self._pad:

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..base import MXNetError, state as _flags, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
+from ..telemetry import trace as _trace, flight as _flight
 from .. import random as _random
 from .mesh import default_mesh
 
@@ -206,6 +207,7 @@ class ShardedTrainStep:
         self._compiled = None
         self._step_count = 0
         self._pending_states = None   # restored blob awaiting first build
+        self._cost_args = None        # avals for cost_analysis()
         # resilience.NonFiniteGuard: the pjit step then also reduces
         # isfinite over loss + every grad and gates the whole writeback
         # on device; the guard reads the flag one step deferred
@@ -449,6 +451,10 @@ class ShardedTrainStep:
             _flags.is_recording = rec
 
     def __call__(self, inputs, labels, lr=None):
+        with _trace.span('step.dispatch', step=self._step_count):
+            return self._call_traced(inputs, labels, lr)
+
+    def _call_traced(self, inputs, labels, lr=None):
         if self._guard is not None:
             # deferred read of the previous step's finiteness flag; a
             # rollback restores params/states/RNG and the post-restore
@@ -478,25 +484,29 @@ class ShardedTrainStep:
                 trainable, frozen = self._collect()
             if any(p._data is None for _, p in trainable + frozen):
                 self.init(*inputs)
-            self._opt_state = {
-                n: self._opt_init(p.data()._data.astype(jnp.float32))
-                for n, p in trainable}
+            with _trace.span('optimizer.state_init'):
+                self._opt_state = {
+                    n: self._opt_init(p.data()._data.astype(jnp.float32))
+                    for n, p in trainable}
             self._build(in_datas, lab_datas)
             # place params on the mesh with their shardings
-            for n, p in self._trainable:
-                p._data[0]._data = _put_replicated(p.data()._data,
-                                                   self._t_shardings[n])
-            for n, p in self._frozen:
-                p._data[0]._data = _put_replicated(p.data()._data,
-                                                   self._f_shardings[n])
-            self._master = {
-                n: _put_replicated(p.data()._data.astype(jnp.float32),
-                                   self._master_shardings[n])
-                for n, p in self._trainable if n in self._master_names}
-            self._opt_state = {
-                n: tuple(_put_replicated(s, sh) for s, sh in
-                         zip(self._opt_state[n], self._state_shardings[n]))
-                for n in self._t_names}
+            with _trace.span('h2d.param_place'):
+                for n, p in self._trainable:
+                    p._data[0]._data = _put_replicated(
+                        p.data()._data, self._t_shardings[n])
+                for n, p in self._frozen:
+                    p._data[0]._data = _put_replicated(
+                        p.data()._data, self._f_shardings[n])
+                self._master = {
+                    n: _put_replicated(p.data()._data.astype(jnp.float32),
+                                       self._master_shardings[n])
+                    for n, p in self._trainable
+                    if n in self._master_names}
+                self._opt_state = {
+                    n: tuple(_put_replicated(s, sh) for s, sh in
+                             zip(self._opt_state[n],
+                                 self._state_shardings[n]))
+                    for n in self._t_names}
             if self._pending_states is not None:
                 doc, self._pending_states = self._pending_states, None
                 self._apply_states(doc)
@@ -510,23 +520,44 @@ class ShardedTrainStep:
         f_params = {n: p.data()._data for n, p in self._frozen}
         key = _random.next_key()
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
-        in_datas = tuple(_put_batch(x, self._batch_sh) for x in in_datas)
-        lab_datas = tuple(_put_batch(x, self._batch_sh) for x in lab_datas)
-        out = self._compiled(
-            t_params, f_params, self._master, self._opt_state, in_datas,
-            lab_datas, key, lr_val, fault_scale)
+        with _trace.span('h2d.batch_put'):
+            in_datas = tuple(_put_batch(x, self._batch_sh)
+                             for x in in_datas)
+            lab_datas = tuple(_put_batch(x, self._batch_sh)
+                              for x in lab_datas)
+        if self._cost_args is None:
+            # abstract avals of one step call, kept for cost_analysis()
+            self._cost_args = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.result_type(x)),
+                (t_params, f_params, self._master, self._opt_state,
+                 in_datas, lab_datas, key, lr_val, fault_scale))
+        with _trace.span('step.compiled'):
+            out = self._compiled(
+                t_params, f_params, self._master, self._opt_state,
+                in_datas, lab_datas, key, lr_val, fault_scale)
         if self._guard is not None:
             new_t, new_f, new_master, new_state, loss, ok = out
             self._guard.push_flag(ok)
         else:
             new_t, new_f, new_master, new_state, loss = out
-        for n, p in self._trainable:
-            p.data()._data = new_t[n]
-        for n, p in self._frozen:
-            p.data()._data = new_f[n]
-        self._master = new_master
-        self._opt_state = new_state
+        with _trace.span('step.gather'):
+            # donate/gather bookkeeping: swap the donated buffers'
+            # NDArray views to the program's outputs (host pointer
+            # swaps; the all-gather itself ran inside the program)
+            for n, p in self._trainable:
+                p.data()._data = new_t[n]
+            for n, p in self._frozen:
+                p.data()._data = new_f[n]
+            self._master = new_master
+            self._opt_state = new_state
         self._step_count += 1
+        if self._comm_plan and _trace.enabled():
+            # the collectives run INSIDE the compiled program — annotate
+            # the trace with the analytic ring-wire plan per step
+            for kind, (nbytes, count) in self._comm_plan.items():
+                _trace.instant(f'comm.{kind}', bytes=int(nbytes),
+                               count=count, axis=self.dp_axis)
         if _telem['on'] and self._comm_plan:
             from .. import telemetry as _telemetry
             for kind, (nbytes, count) in self._comm_plan.items():
@@ -535,7 +566,9 @@ class ShardedTrainStep:
                         nbytes, kind=kind, axis=self.dp_axis)
                 _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
                     count, kind=kind, axis=self.dp_axis)
-        return NDArray(_local_value(loss))
+        loss_nd = NDArray(_local_value(loss))
+        _flight.record_step(self._step_count, loss=loss_nd)
+        return loss_nd
 
     def _replace_params_on_mesh(self):
         """After an external restore wrote host arrays into the
@@ -554,6 +587,23 @@ class ShardedTrainStep:
     # ------------------------------------------------------------------
     # optimizer-state introspection + layout-independent checkpointing
     # ------------------------------------------------------------------
+    def cost_analysis(self):
+        """{'flops', 'bytes'} of ONE compiled step from XLA's own
+        cost_analysis — the deterministic device-side half of the
+        per-step attribution report (telemetry.attribution joins it
+        with the measured wall-time spans). Lowers/compiles the step
+        once more from stored avals (cached by the persistent
+        compilation cache when enabled); None before the first step or
+        when the backend exposes no cost model."""
+        if self._compiled is None or self._cost_args is None:
+            return None
+        from ..telemetry import attribution as _attribution
+        try:
+            compiled = self._compiled.lower(*self._cost_args).compile()
+        except Exception:
+            return None
+        return _attribution.xla_cost(compiled)
+
     def opt_state_bytes_per_device(self):
         """Bytes of optimizer state (masters + moments) ONE device holds.
         Under ZeRO-1 this is ~1/dp of the replicated footprint (± the

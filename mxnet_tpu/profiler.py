@@ -16,6 +16,7 @@ import time
 import jax
 
 from .base import MXNetError, prof_flags as _prof_flags
+from .telemetry import trace as _trace_mod
 
 _config = {
     'filename': 'profile.json',
@@ -49,9 +50,11 @@ def record_op(name, dur_us):
     """One per-op profiler row (called from _imperative.invoke when
     profile_imperative/profile_all is active)."""
     now = time.time() * 1e6
+    # tid from the shared trace registry: profiler op rows and telemetry
+    # spans land in ONE stable small-int tid space (+ thread names)
     ev = {'name': name, 'cat': 'operator', 'ph': 'X',
           'ts': now - dur_us, 'dur': dur_us,
-          'pid': os.getpid(), 'tid': threading.get_ident()}
+          'pid': os.getpid(), 'tid': _trace_mod.tid_for_current_thread()}
     with _events_lock:
         _events.append(ev)
         st = _op_stats.get(name)
@@ -159,18 +162,35 @@ def _telemetry_events():
     return []
 
 
+def _span_events():
+    """Balanced span events (+ thread-name metadata) from the step
+    tracer, merged into the same traceEvents array as the op rows and
+    counter tracks — ONE chrome://tracing-loadable stream, one stable
+    pid/tid space. Empty when tracing is disarmed or has no spans."""
+    try:
+        evs = _trace_mod.chrome_events(flush_open=True)
+        if not evs:
+            return []
+        return _trace_mod.thread_metadata() + evs
+    except Exception:
+        return []
+
+
 def dump(finished=True, profile_process='worker'):
     """Write chrome://tracing JSON (ref: profiler.h:79 'chrome tracing').
 
     With continuous_dump set, events already written are cleared from
     memory and the on-disk trace is extended in place, so repeated dumps
-    neither re-emit nor unboundedly regrow the same trace."""
+    neither re-emit nor unboundedly regrow the same trace. Telemetry 'C'
+    counters and step-tracer spans are folded into the same traceEvents
+    array (span events dedupe across continuous dumps — the tracer's
+    rings are snapshots, not drains)."""
     continuous = _config['continuous_dump']
     with _events_lock:
         new_events = list(_events)
         if continuous:
             _events.clear()
-    events = new_events + _telemetry_events()
+    events = new_events + _telemetry_events() + _span_events()
     if continuous and _state['dumped_in_run'] \
             and os.path.exists(_config['filename']):
         try:
@@ -178,7 +198,12 @@ def dump(finished=True, profile_process='worker'):
                 prev = json.load(f).get('traceEvents', [])
         except (OSError, ValueError):
             prev = []
-        events = prev + events
+        seen = {(e.get('name'), e.get('ph'), e.get('ts'), e.get('tid'))
+                for e in prev}
+        events = prev + [e for e in events
+                         if (e.get('name'), e.get('ph'), e.get('ts'),
+                             e.get('tid')) not in seen]
+    events = _trace_mod.balance_events(events)
     trace = {'traceEvents': events, 'displayTimeUnit': 'ms'}
     with open(_config['filename'], 'w') as f:
         json.dump(trace, f)
@@ -200,13 +225,14 @@ def dumps(reset=False, format='table'):
         if reset:
             _events.clear()
             _op_stats.clear()
-    return json.dumps({'traceEvents': evs + _telemetry_events()})
+    return json.dumps({'traceEvents': _trace_mod.balance_events(
+        evs + _telemetry_events() + _span_events())})
 
 
 def _emit(name, cat, ph, ts=None, args=None, dur=None):
     ev = {'name': name, 'cat': cat, 'ph': ph,
           'ts': (ts if ts is not None else time.time() * 1e6),
-          'pid': os.getpid(), 'tid': threading.get_ident()}
+          'pid': os.getpid(), 'tid': _trace_mod.tid_for_current_thread()}
     if args:
         ev['args'] = args
     if dur is not None:

@@ -245,6 +245,10 @@ def fire(site, occurrence=None):
         from .. import telemetry as _telemetry
         _telemetry.inc('mxnet_tpu_resilience_faults_injected_total',
                        site=site, kind=kind)
+    # flight recorder: a fired fault is exactly the kind of event a
+    # post-mortem needs in its timeline (no-op unless tracing is armed)
+    from ..telemetry import flight as _flight
+    _flight.note('fault', site=site, fault_kind=kind, occurrence=n)
     if kind == 'raise':
         raise InjectedFault(site, n)
     if kind == 'hang':

@@ -146,6 +146,12 @@ class NonFiniteGuard:
         if _telem['on']:
             from .. import telemetry as _telemetry
             _telemetry.inc('mxnet_tpu_resilience_bad_steps_total')
+        # flight recorder: the flag that just drained bad belongs to the
+        # PREVIOUS recorded step (deferred read) — mark it and log the
+        # trip so a crash dump shows the divergence window
+        from ..telemetry import flight as _flight
+        _flight.annotate_last(guard_ok=False)
+        _flight.note('guard.bad_step', consecutive=self.consecutive_bad)
         _log.warning(
             "non-finite training step detected (%d consecutive, "
             "update skipped on device)", self.consecutive_bad)
@@ -162,7 +168,9 @@ class NonFiniteGuard:
     def _rollback(self):
         t0 = _time.perf_counter()
         self.consecutive_bad = 0
-        step = self.manager.restore_latest()
+        from ..telemetry import flight as _flight, trace as _trace
+        with _trace.span('guard.rollback'):
+            step = self.manager.restore_latest()
         if step is None:
             raise MXNetError(
                 "NonFiniteGuard: rollback triggered but no committed "
@@ -184,6 +192,15 @@ class NonFiniteGuard:
             "non-finite guard rolled back to checkpoint step %d "
             "(%.3fs): params, optimizer state, RNG and LR schedule "
             "restored", step, dt)
+        # the rollback ladder is a post-mortem moment: dump the flight
+        # recorder so the NaN burst's span timeline survives the
+        # recovery (failure here must never break the recovery itself)
+        _flight.note('guard.rollback', step=step,
+                     recovery_seconds=round(dt, 4))
+        try:
+            _flight.dump(reason='rollback')
+        except Exception:
+            _log.exception("flight-recorder dump after rollback failed")
         return True
 
     # -- checkpoint gating --------------------------------------------------

@@ -140,6 +140,19 @@ class StepWatchdog:
             from .. import telemetry as _telemetry
             _telemetry.inc('mxnet_tpu_resilience_watchdog_stalls_total')
         report = self._format_report(age, step)
+        # flight recorder: note the stall and dump the black box (span
+        # rings are flushed — open spans get synthetic closes — so the
+        # hang leaves a loadable timeline naming the wedged scope, not
+        # just thread stacks). Must never wedge the watchdog itself.
+        try:
+            from ..telemetry import flight as _flight
+            _flight.note('watchdog.stall', age_seconds=round(age, 1),
+                         step=step)
+            path = _flight.dump(reason='watchdog_stall')
+            if path:
+                report += f"\nflight recorder dumped to {path}"
+        except Exception:
+            _log.exception("watchdog flight-recorder dump failed")
         if self.on_stall is not None:
             try:
                 self.on_stall(report)
@@ -182,6 +195,12 @@ class StepWatchdog:
             snap = _telemetry.report()
             if snap:
                 lines.append(snap)
+        except Exception:
+            pass
+        try:
+            from ..telemetry import flight as _flight, trace as _trace
+            if _trace.enabled():
+                lines.append(_flight.get().format_summary())
         except Exception:
             pass
         return '\n'.join(lines)

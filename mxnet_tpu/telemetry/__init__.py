@@ -1,0 +1,30 @@
+"""Telemetry package: metrics registry + span tracing + attribution.
+
+Grown from the original single-module registry (PR 1) into three
+cooperating layers:
+
+- ``telemetry.metrics`` — the process-global counter/gauge/histogram
+  registry, Prometheus/JSON/chrome-'C' exports and the recompile
+  detector. Its entire API is re-exported here unchanged, so every
+  existing ``telemetry.inc(...)`` / ``telemetry.report()`` call site
+  (and ``MXNET_TPU_TELEMETRY=1``) keeps working.
+- ``telemetry.trace`` — nested ``span()`` scopes over the step
+  lifecycle, per-thread lock-free rings, chrome-trace B/E export
+  (``MXTPU_TRACE=1``).
+- ``telemetry.flight`` — the crash-time flight recorder: last-N-steps
+  span summaries + loss + guard flags + fault events, dumped as one
+  atomic JSON on stall/rollback/exit.
+- ``telemetry.attribution`` — joins measured spans with XLA
+  cost_analysis into the per-step input/h2d/compute/collective/
+  host-sync breakdown bench.py and tools/tune_bert_step.py report.
+"""
+from .metrics import *  # noqa: F401,F403  (the PR-1 registry API, unchanged)
+from .metrics import (  # noqa: F401  (non-__all__ names used by tests/tools)
+    DEFAULT_BUCKETS, Metric, _label_key, _metrics, _snapshot,
+)
+from .metrics import __all__ as _metrics_all
+from . import trace          # noqa: F401
+from . import flight         # noqa: F401
+from . import attribution    # noqa: F401
+
+__all__ = list(_metrics_all) + ['trace', 'flight', 'attribution']

@@ -1,0 +1,329 @@
+"""Crash-time flight recorder: the last N steps, always ready to dump.
+
+A crash or a stall at step 48,213 of a multi-hour run is only
+debuggable if the process carried its own black box: what the recent
+steps spent their time on, what the loss was doing, whether the
+non-finite guard was tripping, which faults fired. The flight recorder
+is that box — a bounded ring of per-step summaries (span self-times
+drained from ``telemetry.trace``, loss, guard flag) plus a bounded log
+of notable events (fault injections, guard trips, rollbacks, watchdog
+stalls), dumped as ONE atomic JSON:
+
+- by the watchdog when the step heartbeat stalls,
+- by the non-finite guard's rollback ladder,
+- at interpreter exit (``atexit``) and on fatal signals
+  (SIGTERM/SIGABRT, chaining any previously installed handler —
+  e.g. the checkpoint preemption hook keeps working),
+- on demand via ``flight.dump(reason=...)``.
+
+The dump also embeds the balanced chrome ``traceEvents`` stream and
+every thread's currently-OPEN spans, so a hang names the exact frame
+each thread was inside (``tools/check_trace.py`` validates the
+embedded stream like any other trace dump).
+
+Armed together with tracing (``MXTPU_TRACE=1``): ``record_step()`` is
+a no-op while tracing is disarmed, so an untraced run pays one dict
+check per step. Loss values are resolved one step deferred — step N's
+device scalar is read when step N+1 is recorded, after its program has
+long finished — so recording never adds a host sync (the same
+deferred-read contract as ``resilience.NonFiniteGuard``).
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import signal as _signal
+import threading
+import time as _time
+
+from ..base import telem_flags as _telem
+from . import trace as _trace
+
+__all__ = ['FlightRecorder', 'get', 'record_step', 'note',
+           'annotate_last', 'dump', 'install_crash_hooks']
+
+
+class FlightRecorder:
+    """Bounded ring of step summaries + event log. One process-global
+    instance (``flight.get()``); tests may build their own."""
+
+    def __init__(self, capacity=None, event_capacity=256):
+        if capacity is None:
+            from .. import config as _config
+            capacity = _config.get('MXTPU_FLIGHT_STEPS')
+        self.capacity = max(1, int(capacity))
+        self._steps = collections.deque(maxlen=self.capacity)
+        self._events = collections.deque(maxlen=int(event_capacity))
+        self._lock = threading.Lock()
+        self._last_t = None          # perf_counter of the previous step
+        self._pending_loss = None    # (record, device scalar) to resolve
+        self.dumps = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record_step(self, step, loss=None, guard_ok=None, extra=None):
+        """One training step completed. `loss` may be a device scalar —
+        it is NOT read here; it resolves at the NEXT record_step (one
+        step deferred, no host sync). No-op while tracing is disarmed."""
+        if not _trace._state['on']:
+            return
+        now = _time.perf_counter()
+        # this thread runs the step loop: only ITS self-times may be
+        # billed against step wall time (attribution); other threads'
+        # spans overlap the step and count only in the totals
+        rec = {'step': int(step), 'time': _time.time(), 'loss': None,
+               'spans_ms': _trace.drain_aggregates(
+                   consumer_tid=_trace.tid_for_current_thread())}
+        if self._last_t is not None:
+            rec['interval_ms'] = round((now - self._last_t) * 1e3, 3)
+        self._last_t = now
+        if guard_ok is not None:
+            rec['guard_ok'] = bool(guard_ok)
+        if extra:
+            rec.update(extra)
+        with self._lock:
+            pending, self._pending_loss = (
+                self._pending_loss, (rec, loss) if loss is not None
+                else None)
+            self._steps.append(rec)
+        # resolve OUTSIDE the lock: the float() is a device read — ~free
+        # a full step after dispatch, but a wedged device must never
+        # wedge the lock (the watchdog's dump needs it to stall-report)
+        self._resolve(pending)
+        _trace._sync_metrics()
+
+    def _pop_pending(self):
+        with self._lock:
+            pending, self._pending_loss = self._pending_loss, None
+        return pending
+
+    @staticmethod
+    def _resolve(pending):
+        """Read a deferred loss scalar into its step record (its program
+        finished a full step ago; a failure records None). The record is
+        already in the ring — a concurrent reader sees None or the
+        float, never corruption."""
+        if pending is None:
+            return
+        rec, loss = pending
+        try:
+            rec['loss'] = float(getattr(loss, '_data', loss))
+        except Exception:
+            rec['loss'] = None
+
+    def note(self, kind, /, **info):
+        """One notable event (fault fired, guard tripped, rollback,
+        stall, ...). Bounded; no-op while tracing is disarmed."""
+        if not _trace._state['on']:
+            return
+        ev = {'kind': kind, 'time': _time.time()}
+        if info:
+            ev.update(info)
+        with self._lock:
+            self._events.append(ev)
+
+    def annotate_last(self, **fields):
+        """Attach fields to the most recent step record (e.g. the
+        guard's one-step-deferred verdict: annotate_last(guard_ok=False)
+        lands on the step whose flag just drained bad)."""
+        if not _trace._state['on']:
+            return
+        with self._lock:
+            if self._steps:
+                self._steps[-1].update(fields)
+
+    # -- reading / dumping -------------------------------------------------
+
+    @contextlib.contextmanager
+    def _locked_for_dump(self, timeout=2.0):
+        """Best-effort lock for the read/dump paths. A crash-time dump
+        must never deadlock: a fatal-signal handler can interrupt THIS
+        thread while it holds the (non-reentrant) lock, and a wedged
+        holder on another thread must not wedge the watchdog's report.
+        After `timeout` we proceed lock-free — safe, because a holder
+        that timed us out is interrupted or blocked, not mutating."""
+        got = self._lock.acquire(timeout=timeout)
+        try:
+            yield
+        finally:
+            if got:
+                self._lock.release()
+
+    def steps(self):
+        with self._locked_for_dump():
+            return [dict(r) for r in self._steps]
+
+    def events(self):
+        with self._locked_for_dump():
+            return [dict(e) for e in self._events]
+
+    def snapshot(self, resolve_loss=False, signal_safe=False):
+        """The full post-mortem document. `resolve_loss=False` at crash
+        time: reading a pending device scalar could block on a wedged
+        device — the dump must never hang. `signal_safe=True` (fatal-
+        signal handlers) additionally skips every metrics-registry
+        touch: the interrupted frame may hold those locks."""
+        if resolve_loss:
+            self._resolve(self._pop_pending())    # device read: no lock
+        with self._locked_for_dump():
+            steps = [dict(r) for r in self._steps]
+            events = [dict(e) for e in self._events]
+        return {
+            'pid': os.getpid(),
+            'time': _time.time(),
+            'steps': steps,
+            'events': events,
+            'open_spans': _trace.open_spans(),
+            'trace_stats': _trace.stats(),
+            'faults_armed': self._armed_faults(),
+            'traceEvents': _trace.chrome_events(flush_open=True,
+                                                metadata=True,
+                                                sync=not signal_safe),
+        }
+
+    @staticmethod
+    def _armed_faults():
+        try:
+            from ..resilience import faults as _faults
+            return _faults.active()
+        except Exception:
+            return {}
+
+    def dump(self, path=None, reason='', signal_safe=False):
+        """Write the post-mortem JSON atomically. Returns the path, or
+        None when there is nothing recorded (or tracing is disarmed) —
+        an empty flight recorder never shadows a real dump.
+        `signal_safe=True` (fatal-signal handlers) skips every
+        metrics-registry touch: the interrupted frame may hold the
+        registry's non-reentrant lock."""
+        if not _trace._state['on']:
+            return None
+        with self._locked_for_dump():
+            empty = not self._steps and not self._events
+        if empty and not _trace.stats()['spans_total']:
+            return None
+        if path is None:
+            from .. import config as _config
+            path = _config.get('MXTPU_FLIGHT_PATH')
+        doc = self.snapshot(resolve_loss=False, signal_safe=signal_safe)
+        doc['reason'] = reason or 'manual'
+        self.dumps += 1
+        if _telem['on'] and not signal_safe:
+            from . import metrics as _metrics
+            _metrics.inc('mxnet_tpu_trace_flight_dumps_total')
+        from ..serialization import atomic_write_file
+        atomic_write_file(path, json.dumps(doc, default=str).encode())
+        return path
+
+    def format_summary(self, last=8):
+        """Human-readable tail for log embedding (the watchdog report)."""
+        steps = self.steps()[-last:]
+        events = self.events()[-last:]
+        lines = ['--- flight recorder (last %d steps) ---' % len(steps)]
+        for r in steps:
+            top = sorted(r['spans_ms'].items(),
+                         key=lambda kv: -kv[1]['self_ms'])[:4]
+            spans = ' '.join(f"{n}={st['self_ms']:.1f}ms" for n, st in top)
+            lines.append(
+                f"step {r['step']}: interval={r.get('interval_ms', '?')}ms "
+                f"loss={r.get('loss')} guard_ok={r.get('guard_ok', '?')} "
+                f"{spans}")
+        for e in events:
+            lines.append(f"event {e['kind']}: "
+                         + ' '.join(f'{k}={v}' for k, v in e.items()
+                                    if k not in ('kind', 'time')))
+        for s in _trace.open_spans():
+            lines.append(f"open span {s['name']} on thread {s['thread']} "
+                         f"for {s['age_ms']:.0f}ms")
+        return '\n'.join(lines)
+
+    def clear(self):
+        with self._lock:
+            self._steps.clear()
+            self._events.clear()
+            self._last_t = None
+            self._pending_loss = None
+
+
+_recorder = None
+_recorder_lock = threading.Lock()
+_hooks = {'atexit': False, 'signals': False}
+
+
+def get() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record_step(step, loss=None, guard_ok=None, extra=None):
+    get().record_step(step, loss=loss, guard_ok=guard_ok, extra=extra)
+
+
+def note(kind, /, **info):
+    get().note(kind, **info)
+
+
+def annotate_last(**fields):
+    get().annotate_last(**fields)
+
+
+def dump(path=None, reason='', signal_safe=False):
+    return get().dump(path=path, reason=reason, signal_safe=signal_safe)
+
+
+def _atexit_dump():
+    try:
+        get().dump(reason='atexit')
+    except Exception:
+        pass
+
+
+def _make_signal_handler(signum, prev):
+    def handler(sig, frame):
+        try:
+            get().dump(reason=f'signal:{_signal.Signals(sig).name}',
+                       signal_safe=True)
+        except Exception:
+            pass
+        if callable(prev):
+            prev(sig, frame)             # chain (e.g. checkpoint SIGTERM)
+        elif prev == _signal.SIG_DFL:
+            _signal.signal(sig, _signal.SIG_DFL)
+            _signal.raise_signal(sig)
+    return handler
+
+
+def install_crash_hooks(signals=(getattr(_signal, 'SIGTERM', None),
+                                 getattr(_signal, 'SIGABRT', None))):
+    """Register the atexit dump and chain fatal-signal handlers so any
+    crash leaves the post-mortem artifact. Idempotent; signal hooks are
+    skipped quietly off the main thread (signal.signal would raise)."""
+    if not _hooks['atexit']:
+        _hooks['atexit'] = True
+        atexit.register(_atexit_dump)
+    if not _hooks['signals']:
+        try:
+            for sig in signals:
+                if sig is None:
+                    continue
+                prev = _signal.getsignal(sig)
+                _signal.signal(sig, _make_signal_handler(sig, prev))
+            _hooks['signals'] = True
+        except ValueError:
+            pass                         # not the main thread
+
+
+# armed together with tracing: MXTPU_TRACE=1 runs always leave a black
+# box behind (an explicit trace.enable() mid-run can call
+# install_crash_hooks itself)
+from .. import config as _config_mod  # noqa: E402
+
+if _config_mod.get('MXTPU_TRACE'):
+    install_crash_hooks()

@@ -34,7 +34,7 @@ import time as _time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
-from .base import MXNetError, telem_flags as _telem
+from ..base import MXNetError, telem_flags as _telem
 
 __all__ = [
     'enable', 'disable', 'enabled', 'reset', 'report', 'dump', 'prometheus',
@@ -249,7 +249,7 @@ def set_recompile_threshold(n: Optional[int]):
 def _threshold() -> int:
     if _recompile_threshold is not None:
         return _recompile_threshold
-    from . import config as _config
+    from .. import config as _config
     return _config.get('MXNET_TPU_RECOMPILE_WARN_THRESHOLD')
 
 
@@ -441,7 +441,7 @@ def chrome_events():
 
 
 # config gate (read at import; see config.py for the declaration)
-from . import config as _config_mod  # noqa: E402
+from .. import config as _config_mod  # noqa: E402
 
 if _config_mod.get('MXNET_TPU_TELEMETRY'):
     enable()

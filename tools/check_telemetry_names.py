@@ -93,6 +93,15 @@ SUBSYSTEM_METRICS = {
         # optimizer state (fp32 masters + moments) held by ONE device
         'mxnet_tpu_comm_opt_state_bytes_per_device': 'gauge',
     },
+    'mxnet_tpu_trace_': {
+        # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
+        # dropped by ring overwrite, events currently buffered across
+        # every thread ring, and flight-recorder post-mortem dumps
+        'mxnet_tpu_trace_spans_total': 'counter',
+        'mxnet_tpu_trace_dropped_spans_total': 'counter',
+        'mxnet_tpu_trace_ring_depth': 'gauge',
+        'mxnet_tpu_trace_flight_dumps_total': 'counter',
+    },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
         'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
