@@ -336,6 +336,110 @@ def _io_report(n_images=384, src_hw=(360, 480), out_hw=224):
                 "ref_baseline_images_per_sec": 3000}
 
 
+def _zero_probe_child() -> None:
+    """``--zero-probe``: one JSON line with the ZeRO memory trajectory
+    on a forced 8-device host-CPU mesh — the tiny-BERT pjit step at
+    stage off/1/3, param + master + optimizer bytes per device, gather
+    wire bytes per step, and the 3-step loss parity across stages.
+    Runs as its own process because the host device count must be fixed
+    before jax initializes."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    prev = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in prev:
+        os.environ['XLA_FLAGS'] = \
+            (prev + ' --xla_force_host_platform_device_count=8').strip()
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import BertForPretraining
+    from mxnet_tpu.models.bert import bert_pretrain_loss
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+
+    cfg = dict(vocab_size=1024, hidden=128, layers=2, heads=4,
+               intermediate=256, max_len=128, type_vocab=2, dropout=0.0)
+    mesh = make_mesh((8,), ('dp',))
+    rng = onp.random.RandomState(0)
+    batch, seq = 8, 64
+    tokens = nd.array(rng.randint(0, cfg['vocab_size'], (batch, seq))
+                      .astype(onp.int32))
+    types = nd.array(onp.zeros((batch, seq), onp.int32))
+    labels = onp.full((batch, seq), -1, onp.int32)
+    labels[:, :8] = rng.randint(0, cfg['vocab_size'], (batch, 8))
+    labels = nd.array(labels)
+    nsp = nd.array(rng.randint(0, 2, batch).astype(onp.int32))
+
+    rep, losses = {'dp': 8}, {}
+    for stage in (0, 1, 3):
+        mx.random.seed(0)
+        model = BertForPretraining(cfg)
+        model.initialize(mx.init.Normal(0.02))
+        step = ShardedTrainStep(model, bert_pretrain_loss, 'adamw',
+                                {'learning_rate': 1e-4}, mesh=mesh,
+                                zero=stage)
+        losses[stage] = [
+            float(step([tokens, types], [labels, nsp]).asscalar())
+            for _ in range(3)]
+        pb = step.param_bytes_per_device()
+        sb = step.opt_state_bytes_per_device()
+        rep[f'stage{stage}'] = {
+            'param_bytes_per_device': pb,
+            'opt_state_bytes_per_device': sb,
+            'persistent_bytes_per_device': pb + sb,
+            'gather_bytes_per_step': step.gather_bytes_per_step(),
+            'comm_bytes_per_step': {k: int(v[0]) for k, v in
+                                    step._comm_plan.items()},
+        }
+    rep['loss_max_diff_3v1'] = max(
+        abs(a - b) for a, b in zip(losses[3], losses[1]))
+    rep['loss_max_diff_3v0'] = max(
+        abs(a - b) for a, b in zip(losses[3], losses[0]))
+    print(json.dumps(rep), flush=True)
+
+
+def _zero_report(step, timeout=240.0):
+    """The ``"zero"`` field: the live bench step's ZeRO stage and
+    residency numbers, plus — when the live mesh has no >1-device dp
+    axis (the 1-device CPU smoke) — a ``--zero-probe`` subprocess on a
+    forced 8-device mesh so BENCH rounds capture the off/1/3 memory
+    trajectory either way."""
+    live = {
+        'stage': getattr(step, 'zero_stage', 1 if step.zero else 0),
+        'dp': step._dp_size,
+        'param_bytes_per_device': step.param_bytes_per_device(),
+        'opt_state_bytes_per_device': step.opt_state_bytes_per_device(),
+        'gather_bytes_per_step': step.gather_bytes_per_step(),
+        'comm_bytes_per_step': {k: int(v[0]) for k, v in
+                                (step._comm_plan or {}).items()},
+    }
+    if step._dp_size > 1:
+        return live
+    # never let the probe blow the child's overall budget (same contract
+    # as the resnet report): clamp to the remaining deadline and skip
+    # when too little is left for three stage compiles
+    child_deadline = float(os.environ.get('BENCH_CHILD_DEADLINE', '0'))
+    if child_deadline:
+        timeout = min(timeout, child_deadline - time.time() - 30)
+        if timeout < 45:
+            live['dp8_probe'] = {'skipped': 'child deadline too close'}
+            return live
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), '--zero-probe'],
+            capture_output=True, text=True, timeout=timeout)
+        for line in reversed(res.stdout.strip().splitlines()):
+            try:
+                live['dp8_probe'] = json.loads(line)
+                break
+            except ValueError:
+                continue
+        else:
+            live['dp8_probe'] = {
+                'error': f'no JSON line (rc={res.returncode}): '
+                         f'{res.stderr[-200:]}'}
+    except subprocess.TimeoutExpired:
+        live['dp8_probe'] = {'error': f'timeout after {timeout}s'}
+    return live
+
+
 def _attribution_report(step, model, run_step, flops, peak_total,
                         steps=8):
     """Per-step attribution (ISSUE 6): arm span tracing, run a few
@@ -366,7 +470,8 @@ def _attribution_report(step, model, run_step, flops, peak_total,
     rep = attribution.report(
         flight.get().steps(), flops_per_step=flops,
         peak_flops=peak_total,
-        collective_bytes={k: v[0] for k, v in comm_plan.items()})
+        collective_bytes={k: v[0] for k, v in comm_plan.items()},
+        gather_layers=getattr(step, '_gather_plan', None))
     xla = step.cost_analysis()
     if xla:
         rep['xla_cost_per_step'] = xla
@@ -553,6 +658,16 @@ def _child(mode: str) -> None:
         except Exception as e:
             out["io"] = {"error": repr(e)[:300]}
             _log(f"io report failed: {e!r}")
+    # ZeRO memory trajectory (ISSUE 7): stage + bytes/device + gather
+    # wire bytes on the live step, with an 8-device probe when the live
+    # mesh is single-device
+    try:
+        out["zero"] = _zero_report(step)
+        _log(f"zero report: {out['zero']}")
+    except Exception as e:
+        out["zero"] = {"error": repr(e)[:300]}
+        _log(f"zero report failed: {e!r}")
+    print(json.dumps(out), flush=True)
     # attribution LAST: with MXTPU_TRACE=1 the whole child traced from
     # import, so the dumped timeline also carries the io report's spans
     try:
@@ -613,6 +728,9 @@ def _run_child(mode: str, timeout: float):
 
 
 def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == '--zero-probe':
+        _zero_probe_child()
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == '--child':
         if sys.argv[2] == 'probe':
             _probe()

@@ -336,10 +336,15 @@ class CheckpointManager:
             # into ZeRO or replicated trainers alike. Record the layout
             # it was written UNDER so cross-degree resumes are auditable.
             tr = self._trainer
+            stage = int(getattr(tr, 'zero_stage',
+                                getattr(tr, '_zero_stage', 0)) or 0)
+            if stage == 0 and (getattr(tr, '_zero_active', False)
+                               or getattr(tr, 'zero', False)):
+                stage = 1
             meta.setdefault('optimizer_state_layout', {
                 'format': 'gathered-host',
-                'zero1': bool(getattr(tr, '_zero_active', False)
-                              or getattr(tr, 'zero', False)),
+                'zero1': stage >= 1,
+                'stage': stage,
                 'dp': int(getattr(tr, '_zero_dp', 0)
                           or getattr(tr, '_dp_size', 1)),
             })

@@ -213,12 +213,33 @@ register('MXNET_TPU_IO_CORRUPT_POLICY', str, 'error',
          "mid-epoch: 'error' raises DataError naming the record index "
          "and file offset; 'skip' substitutes the next good record and "
          "counts mxnet_tpu_io_corrupt_records_total.")
-register('MXTPU_ZERO', _bool, True,
-         'ZeRO-1 sharded optimizer update on the GSPMD data-parallel '
-         'path: gradients reduce-scatter over the dp axis, each device '
-         'runs the optimizer on its 1/dp slice of the fp32 masters and '
+def _zero_stage(s):
+    """MXTPU_ZERO value -> ZeRO stage int: 0/off/false -> 0, 1/on/true
+    -> 1, 3 -> 3 (stage 2 has no separate meaning on the GSPMD path —
+    grads already reduce-scatter under stage 1)."""
+    raw = str(s).strip().lower()
+    if raw in ('3',):
+        return 3
+    if raw in ('1', 'true', 'on', 'yes', 'y', 'enabled'):
+        return 1
+    if raw in ('0', 'false', 'off', '', 'no', 'n', 'none', 'disabled'):
+        return 0
+    raise ValueError(f"MXTPU_ZERO={s!r}: expected 0 (off), 1 (sharded "
+                     f"optimizer state) or 3 (sharded params + grads + "
+                     f"state / FSDP)")
+
+
+register('MXTPU_ZERO', _zero_stage, 1,
+         'ZeRO stage of the sharded update on the GSPMD data-parallel '
+         'path. 1 (default whenever a dp axis with >1 devices is '
+         'present): gradients reduce-scatter over dp, each device runs '
+         'the optimizer on its 1/dp slice of the fp32 masters and '
          'moments, and updated params all-gather back to the compute '
          'dtype — all inside the one pjit step so XLA overlaps the '
-         'collectives with backward compute. Default on whenever a dp '
-         'axis with >1 devices is present; set 0 to force the fully '
-         'replicated update.')
+         'collectives with backward compute. 3 (ZeRO-3/FSDP): the '
+         'persistent params and masters ALSO live 1/dp-sharded; each '
+         "layer's params all-gather on first use inside the step "
+         '(prefetched one layer ahead), are rematerialized for '
+         'backward instead of kept, and grads reduce-scatter straight '
+         'into the shard-local update. 0 forces the fully replicated '
+         'update.')

@@ -50,10 +50,14 @@ class Trainer:
         # steps (eval pass, checkpoint save) as step time.
         self._telem_last_step = None
         self._telem_step_ema = None
-        # ZeRO-1 state of the fused update; populated by _fused_apply
-        # when the weights live on a >1-device dp mesh (see _zero_layout)
+        # ZeRO state of the fused update; populated by _fused_apply
+        # when the weights live on a >1-device dp mesh (see _zero_layout).
+        # Stage 1 shards the optimizer states 1/dp; stage 3 (MXTPU_ZERO=3)
+        # additionally re-places the weight NDArrays themselves sharded.
         self._zero_active = False
         self._zero_dp = 1
+        self._zero_stage = 0
+        self._zero3_mesh = None   # mesh to re-place onto after a restore
         # resilience.NonFiniteGuard bound via attach_guard(): the fused
         # update then also reduces isfinite over every gradient and
         # skips the writeback ON DEVICE when the step is non-finite
@@ -363,34 +367,79 @@ class Trainer:
         each optimizer-state tensor (fp32 master + moments) then shards
         1/dp over that axis — the traced multi-tensor update computes
         only the local slice and all-gathers the updated weights back to
-        their own layout. With zero off the states replicate."""
+        their own layout. With zero off the states replicate.
+
+        Stage 3 (MXTPU_ZERO=3): the weight NDArrays THEMSELVES are
+        re-placed dp-sharded (one batched device_put) and the fused
+        update's out_shardings keep them sharded — eager forward/backward
+        consume the logically-global sharded arrays directly, so user
+        training loops run unmodified. A checkpoint restore rewrites the
+        params as host arrays; the mesh is remembered and the placement
+        re-runs on the next fused-cache rebuild (set_states_bytes clears
+        the cache)."""
         from jax.sharding import NamedSharding, PartitionSpec
         from ..parallel.step import compose_zero_spec
+        from .. import config as _config
+        stage = int(_config.get('MXTPU_ZERO') or 0)
         mesh = None
+        on_mesh = True
         for _, _, _, datas in items:
             sh = datas[0]._data.sharding
             if not isinstance(sh, NamedSharding):
-                return None
+                on_mesh = False
+                break
             if mesh is None:
                 mesh = sh.mesh
             elif sh.mesh != mesh:
                 return None
+        replaced = False
+        if not on_mesh:
+            # a restore (CheckpointManager / load_params) rewrote the
+            # weights as host arrays: under a previously-active stage 3
+            # re-adopt the remembered mesh and re-place below
+            if stage == 3 and self._zero3_mesh is not None:
+                mesh, replaced = self._zero3_mesh, True
+            else:
+                return None
         if mesh is None:
             return None
-        from .. import config as _config
         dp = dict(mesh.shape).get('dp', 0)
-        zero_on = bool(_config.get('MXTPU_ZERO')) and dp > 1
-        w_sh, state_sh = [], []
+        zero_on = stage >= 1 and dp > 1
+        stage3 = stage == 3 and dp > 1
+        repl = NamedSharding(mesh, PartitionSpec())
+        w_sh, state_sh, place = [], [], []
         for _, _, _, datas in items:
-            sh = datas[0]._data.sharding
-            w_sh.append(sh)
-            zspec = compose_zero_spec(tuple(datas[0].shape), sh.spec,
+            cur = datas[0]._data.sharding
+            if not isinstance(cur, NamedSharding):
+                cur = repl
+            zspec = compose_zero_spec(tuple(datas[0].shape), cur.spec,
                                       'dp', dp) if zero_on else None
-            state_sh.append(NamedSharding(mesh, zspec)
-                            if zspec is not None else None)
+            zsh = NamedSharding(mesh, zspec) if zspec is not None else None
+            target = zsh if (stage3 and zsh is not None) else cur
+            w_sh.append(target)
+            state_sh.append(zsh)
+            if (stage3 or replaced) and \
+                    datas[0]._data.sharding != target:
+                place.append((datas[0], target))
+        if place:
+            import jax
+            placed = jax.device_put([d._data for d, _ in place],
+                                    [sh for _, sh in place])
+            nbytes = 0
+            for (d, _), out in zip(place, placed):
+                d._data = out
+                nbytes += int(out.size) * out.dtype.itemsize
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.counter(
+                    'mxnet_tpu_comm_collective_bytes_total').inc(
+                        nbytes, kind='param_scatter', axis='dp')
+                _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
+                    1, kind='param_scatter', axis='dp')
+        self._zero3_mesh = mesh if stage3 else None
         return {'mesh': mesh, 'dp': dp if zero_on else 1, 'zero': zero_on,
-                'w_sh': w_sh, 'state_sh': state_sh,
-                'repl': NamedSharding(mesh, PartitionSpec())}
+                'stage': (3 if stage3 else 1) if zero_on else 0,
+                'w_sh': w_sh, 'state_sh': state_sh, 'repl': repl}
 
     def _zero_place_states(self, items, zero):
         """Scatter optimizer-state NDArrays into the ZeRO layout (one
@@ -438,20 +487,21 @@ class Trainer:
             _telemetry.set_gauge(
                 'mxnet_tpu_comm_opt_state_bytes_per_device',
                 self.opt_state_bytes_per_device())
+            _telemetry.set_gauge(
+                'mxnet_tpu_comm_param_bytes_per_device',
+                self.param_bytes_per_device())
 
     def opt_state_bytes_per_device(self):
         """Bytes of optimizer state ONE device holds (ZeRO-1: ~1/dp of
         the replicated footprint, ± tensors too small to shard)."""
         from ..ndarray.ndarray import NDArray
+        from ..parallel.step import device_nbytes
         total = 0
 
         def _walk(s):
             nonlocal total
             if isinstance(s, NDArray):
-                d = s._data
-                shards = getattr(d, 'addressable_shards', None)
-                total += shards[0].data.nbytes if shards \
-                    else int(d.size) * d.dtype.itemsize
+                total += device_nbytes(s._data)
             elif isinstance(s, (list, tuple)):
                 for x in s:
                     _walk(x)
@@ -459,6 +509,19 @@ class Trainer:
         if self._updater is not None:
             for st in self._updater.states.values():
                 _walk(st)
+        return total
+
+    def param_bytes_per_device(self):
+        """Bytes of the parameters' primary copies ONE device holds —
+        under ZeRO-3 (stage-3 fused layout) the dp-sharded weights count
+        their 1/dp shard; replicated/single-device weights count in
+        full."""
+        from ..parallel.step import device_nbytes
+        total = 0
+        for p in self._params:
+            if p._data is None:
+                continue
+            total += device_nbytes(p.data()._data)
         return total
 
     def _fused_apply(self, items):
@@ -527,15 +590,17 @@ class Trainer:
                tuple(d._data.dtype.name for _, _, _, ds in items
                      for d in ds[:1]),
                guard_on,
-               (self._zero_active, self._zero_dp))
+               (self._zero_active, self._zero_dp, self._zero_stage))
         cache = getattr(self, '_fused_cache', None)
         if cache is None or cache[0] != sig:
             zero = self._zero_layout(items)
             self._zero_active = zero is not None and zero['zero']
             self._zero_dp = zero['dp'] if zero else 1
+            self._zero_stage = zero['stage'] if zero else 0
             if zero is not None:
                 self._zero_place_states(items, zero)
-            sig = sig[:4] + ((self._zero_active, self._zero_dp),)
+            sig = sig[:4] + ((self._zero_active, self._zero_dp,
+                              self._zero_stage),)
             structs = [updater.states[i] for i in indices]
             zero_cache = zero
 
@@ -561,7 +626,7 @@ class Trainer:
                                    staticmethod(lambda idx: ts[pos[idx]])})()
                 opt.rescale_grad = rescale
                 try:
-                    new_w, new_s = [], []
+                    new_w, new_s, gs = [], [], []
                     for n, idx in enumerate(indices):
                         w = NDArray(weights[n])
                         gdat = grads[n]
@@ -573,6 +638,7 @@ class Trainer:
                             # the full copy live through the update
                             gdat = jax.lax.with_sharding_constraint(
                                 gdat, zero_cache['state_sh'][n])
+                        gs.append(gdat)
                         g = NDArray(gdat)
                         st = _reshape(structs[n], leaves)
                         opt.update_multi_precision(idx, w, g, st)
@@ -591,14 +657,17 @@ class Trainer:
                     opt.rescale_grad = saved_rescale
                 if guard_on:
                     # non-finite guard, fused into THIS program: one
-                    # isfinite reduction over every raw gradient, and the
-                    # whole writeback gated on it — a NaN/Inf step keeps
-                    # the old weights and optimizer state on device; the
-                    # host reads the flag a step later (no extra sync)
+                    # isfinite reduction over every gradient in its
+                    # SHARDED (reduce-scattered) layout where ZeRO is
+                    # active — each device scans 1/dp and GSPMD psums
+                    # the flag — and the whole writeback gated on it; a
+                    # NaN/Inf step keeps the old weights and optimizer
+                    # state on device; the host reads the flag a step
+                    # later (no extra sync)
                     import functools as _functools
                     ok = _functools.reduce(
                         jnp.logical_and,
-                        [jnp.all(jnp.isfinite(g)) for g in grads])
+                        [jnp.all(jnp.isfinite(g)) for g in gs])
                     new_w = [jnp.where(ok, nw, w)
                              for nw, w in zip(new_w, weights)]
                     new_s = [jnp.where(ok, ns, s)

@@ -13,7 +13,7 @@ pjit/shard_map; XLA emits the collectives over ICI/DCN.
 from .mesh import (make_mesh, default_mesh, set_default_mesh, mesh_shape,
                    data_parallel_spec, replicate_spec)
 from . import collectives
-from .step import ShardedTrainStep
+from .step import ShardedTrainStep, compose_zero_spec, zero3_layout
 from . import dist
 from .ring_attention import ring_attention
 from .pipeline import (pipeline_forward, pipeline_loss_fn,

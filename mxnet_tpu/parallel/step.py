@@ -16,6 +16,22 @@ becomes a reduce-scatter, each device updates only its 1/dp slice, and
 the updated params all-gather back — same wire bytes, 1/dp optimizer
 math and state HBM per device. See the mxnet_tpu_comm_* telemetry
 contract for the per-run accounting.
+
+ZeRO-3 / FSDP (MXTPU_ZERO=3 or zero=3): the PERSISTENT parameters
+themselves (and the fp32 masters) additionally live dp-sharded between
+steps (Rajbhandari et al. 2020 stage 3; Zhao et al. 2023 FSDP). Inside
+the compiled step each layer's params are all-gathered on first use —
+the gathers are chained per layer (``collectives.ordered_barrier``) so
+layer k+1's gather overlaps layer k's compute, not one monolithic
+up-front gather — and the gathered copies are NOT saved as autodiff
+residuals (``jax.checkpoint`` with a ``save_any_names_but_these``
+policy on the gather outputs): the backward pass regathers, so full
+copies exist only transiently. Gradients reduce-scatter straight into
+the shard-local update and the updated params are written back SHARDED
+(no trailing all-gather — the next step's per-layer gathers do that
+work). Net: param + master + optimizer persistent HBM all drop to
+~1/dp, at the cost of one extra all-gather of the params per step (the
+backward regather) in ring wire bytes.
 """
 from __future__ import annotations
 
@@ -24,6 +40,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as onp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError, state as _flags, telem_flags as _telem
@@ -31,6 +48,7 @@ from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
 from ..telemetry import trace as _trace, flight as _flight
 from .. import random as _random
+from .collectives import group_params_by_layer, ordered_barrier
 from .mesh import default_mesh
 
 
@@ -66,30 +84,98 @@ def _local_value(arr):
     return arr
 
 
+def device_nbytes(arr):
+    """Bytes of ``arr`` ONE device physically holds: the local shard for
+    a sharded global array, the full buffer for replicated/host arrays —
+    the unit of the per-device residency accounting (ZeRO gauges)."""
+    shards = getattr(arr, 'addressable_shards', None)
+    if shards:
+        return shards[0].data.nbytes
+    return int(arr.size) * jnp.dtype(arr.dtype).itemsize
+
+
 def compose_zero_spec(shape, base_spec, dp_axis, dp_size):
-    """ZeRO-1 layout for an optimizer-state/master tensor: compose a dp
+    """ZeRO layout for an optimizer-state/master tensor: compose a dp
     shard onto the parameter's (tp) PartitionSpec. Picks the first dim
-    not already claimed by another mesh axis whose size splits evenly
-    over dp; falls back to a padded (ragged) shard when only an uneven
-    dim is available. None when nothing is shardable (scalars and
-    sub-dp-size tensors stay replicated — they are the ±padding slack in
-    the 1/dp state-footprint accounting)."""
+    not already claimed by another mesh axis whose size splits EVENLY
+    over dp. None when nothing is shardable (scalars, sub-dp-size and
+    ragged tensors stay replicated — the ±slack of the 1/dp footprint;
+    ZeRO-3 recovers the ragged ones via flatten+pad, see
+    ``zero3_layout``).
+
+    A base spec that itself proposes ``dp_axis`` on a non-divisible dim
+    raises MXNetError up front: this jax refuses uneven NamedShardings
+    at device_put/jit time with an opaque size error, so composing such
+    a spec would only defer the failure."""
     spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
-    for s in spec:
+    for i, s in enumerate(spec):
         # already sharded over dp (fsdp-style param_specs): the state
         # inherits the param's own 1/dp layout — composing again would
         # produce an invalid duplicate-axis spec
         if s == dp_axis or (isinstance(s, (tuple, list)) and dp_axis in s):
+            if dp_size > 1 and shape[i] % dp_size != 0:
+                raise MXNetError(
+                    f"compose_zero_spec: spec {tuple(base_spec)!r} shards "
+                    f"dim {i} (size {shape[i]}) over the {dp_size}-device "
+                    f"'{dp_axis}' axis, but {shape[i]} is not divisible "
+                    f"by {dp_size} — XLA refuses uneven shardings. Pad "
+                    f"the dim, drop '{dp_axis}' from the spec, or let "
+                    f"ZeRO-3 flatten+pad it (zero3_layout).")
             return None
-    for exact in (True, False):
-        for i, s in enumerate(spec):
-            if s is not None or shape[i] < dp_size:
-                continue
-            if exact and shape[i] % dp_size != 0:
-                continue
-            spec[i] = dp_axis
-            return P(*spec)
+    for i, s in enumerate(spec):
+        if s is not None or shape[i] < dp_size \
+                or shape[i] % dp_size != 0:
+            continue
+        spec[i] = dp_axis
+        return P(*spec)
     return None
+
+
+def zero3_layout(shape, base_spec, dp_axis, dp_size):
+    """Persistent ZeRO-3 layout for one parameter. Returns a dict:
+
+    - ``{'mode': 'dim', 'spec': P(...), 'gather_spec': P(...)}`` — an
+      exactly-divisible free dim shards over dp (composed with any tp
+      dims the param already claims); the param/master/moments live in
+      logical shape with that spec, and the in-step gather restores
+      ``gather_spec`` (the tp-only layout the forward computes in).
+    - ``{'mode': 'flat', 'size': s, 'padded': p, 'pad': p - s}`` — no
+      dim divides evenly: the fp32 master + moments live as a 1-D
+      buffer padded to a dp multiple and sharded ``P(dp)``; the
+      compute-dtype param keeps a replicated logical copy (these are
+      the ragged stragglers — the pad bytes are reported by
+      ``opt_state_bytes_per_device``). Never chosen for tp-sharded
+      params (flattening would destroy the tp layout).
+    - ``{'mode': 'repl'}`` — too small to shard; fully replicated.
+    """
+    spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+
+    def _trim(entries):
+        entries = list(entries)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    for i, s in enumerate(spec):
+        if s == dp_axis or (isinstance(s, (tuple, list)) and dp_axis in s):
+            # user proposed the dp shard (fsdp-style): validate and keep
+            compose_zero_spec(shape, base_spec, dp_axis, dp_size)
+            gspec = [None if ss == dp_axis else
+                     (tuple(a for a in ss if a != dp_axis) or None
+                      if isinstance(ss, (tuple, list)) else ss)
+                     for ss in spec]
+            return {'mode': 'dim', 'spec': P(*spec),
+                    'gather_spec': _trim(gspec)}
+    composed = compose_zero_spec(shape, base_spec, dp_axis, dp_size)
+    if composed is not None:
+        return {'mode': 'dim', 'spec': composed,
+                'gather_spec': _trim(spec)}
+    size = int(onp.prod(shape)) if shape else 1
+    if size >= dp_size and all(s is None for s in spec):
+        padded = -(-size // dp_size) * dp_size
+        return {'mode': 'flat', 'size': size, 'padded': padded,
+                'pad': padded - size}
+    return {'mode': 'repl'}
 
 
 def _sgd_init(p):
@@ -197,9 +283,20 @@ class ShardedTrainStep:
         if zero is None:
             from .. import config as _cfg
             zero = _cfg.get('MXTPU_ZERO')
+        stage = int(zero) if not isinstance(zero, bool) else int(bool(zero))
+        if stage not in (0, 1, 3):
+            raise MXNetError(
+                f"zero={zero!r}: supported ZeRO stages are 0 (off), 1 "
+                f"(sharded optimizer state) and 3 (sharded params + "
+                f"grads + state / FSDP); stage 2 has no separate "
+                f"meaning on the GSPMD path (gradients already "
+                f"reduce-scatter under stage 1).")
         # ZeRO-1: default-on when a >1-device dp axis exists (the fp32
-        # masters + Adam moments then live 1/dp per device)
-        self.zero = bool(zero) and dp_size > 1
+        # masters + Adam moments then live 1/dp per device). ZeRO-3
+        # additionally shards the persistent params (gathered per layer
+        # on use inside the step).
+        self.zero_stage = stage if dp_size > 1 else 0
+        self.zero = self.zero_stage > 0
         self._dp_size = dp_size
         self._params = None       # list[(name, Parameter)]
         self._master = None       # fp32 master copies of bf16/fp16 params
@@ -322,19 +419,53 @@ class ShardedTrainStep:
         # all-reduce into reduce-scatter — and out_shardings all-gather
         # the updated param back to its replicated/tp layout. GSPMD fuses
         # and overlaps both collectives with backward compute.
+        shapes = {n: tuple(p.data().shape) for n, p in trainable}
+        stage3 = self.zero_stage == 3
         zero_specs = {n: None for n in t_names}
-        if self.zero:
-            shapes = {n: tuple(p.data().shape) for n, p in trainable}
+        z3 = {}
+        if stage3:
+            # ZeRO-3: every trainable gets a persistent layout — dim
+            # (sharded in logical shape), flat (fp32 store padded to a
+            # dp multiple) or repl (too small)
+            for n in t_names:
+                z3[n] = zero3_layout(shapes[n], self._spec_for(n),
+                                     self.dp_axis, self._dp_size)
+                if z3[n]['mode'] == 'dim':
+                    zero_specs[n] = z3[n]['spec']
+        elif self.zero:
             for n in t_names:
                 zero_specs[n] = compose_zero_spec(
                     shapes[n], self._spec_for(n), self.dp_axis,
                     self._dp_size)
         self.zero_specs = zero_specs
+        self.zero3_layouts = z3
+        self._shapes = shapes
+        self._zero_label = 'zero3' if stage3 else \
+            ('zero1' if self.zero else 'off')
+        flat_meta = {n: z3[n] for n in t_names
+                     if stage3 and z3[n]['mode'] == 'flat'}
+        dim_names = [n for n in t_names
+                     if stage3 and z3[n]['mode'] == 'dim']
+        # flat params: the compute-dtype logical copy stays replicated;
+        # the fp32 master IS the (padded, dp-sharded) persistent store,
+        # so they join master_names regardless of dtype
+        master_names = frozenset(master_names) | frozenset(flat_meta)
+        if stage3:
+            # persistent params live dp-sharded between steps
+            for n in dim_names:
+                t_shardings[n] = NamedSharding(mesh, z3[n]['spec'])
+        flat_sh = NamedSharding(mesh, P(self.dp_axis))
         zero_shardings = {
-            n: (NamedSharding(mesh, zero_specs[n])
+            n: (flat_sh if n in flat_meta else
+                NamedSharding(mesh, zero_specs[n])
                 if zero_specs[n] is not None else t_shardings[n])
             for n in t_names}
-        # optimizer state shards like its parameter (ZeRO: like its slice)
+        # optimizer state shards like its parameter (ZeRO: like its
+        # slice). ZeRO-3 flat params carry flat (padded) moments — put
+        # them in place before the shardings are derived from them.
+        for n, fz in flat_meta.items():
+            self._opt_state[n] = self._opt_init(
+                jnp.zeros((fz['padded'],), jnp.float32))
         state_shardings = {
             n: tuple((repl if s.ndim == 0 else zero_shardings[n])
                      for s in self._opt_state[n])
@@ -344,12 +475,57 @@ class ShardedTrainStep:
         shard_constraint = {n: zero_shardings[n] for n in t_names
                             if zero_specs[n] is not None}
 
+        # ZeRO-3 per-layer gather pipeline: one chained all-gather per
+        # layer group, in (heuristic) first-use order
+        layer_groups = group_params_by_layer(dim_names) if dim_names \
+            else []
+        self._layer_groups = layer_groups
+        gather_ns = {n: NamedSharding(mesh, z3[n]['gather_spec'])
+                     for n in dim_names}
+
+        if stage3 and dim_names:
+            def gather_all(t_params):
+                """All-gather the dim-sharded params layer by layer:
+                each group's gather is barrier-chained to the PREVIOUS
+                group's gather (not its compute), so XLA can prefetch
+                layer k+1's params while layer k computes; the gathered
+                values are checkpoint-named so the remat policy below
+                drops them from the autodiff residuals (the backward
+                pass regathers)."""
+                gathered = dict(t_params)
+                token = None
+                for _gname, names in layer_groups:
+                    vals = [t_params[n] for n in names]
+                    if token is not None:
+                        out = ordered_barrier(*(vals + [token]))
+                        vals = list(out[:-1])
+                    vals = [checkpoint_name(
+                        jax.lax.with_sharding_constraint(v, gather_ns[n]),
+                        'zero3_gather')
+                        for n, v in zip(names, vals)]
+                    for n, v in zip(names, vals):
+                        gathered[n] = v
+                    token = vals[0]
+                return gathered
+
+            def forward_sharded(t_params, f_params, inputs, labels, key,
+                                fault_scale):
+                return forward_loss(gather_all(t_params), f_params,
+                                    inputs, labels, key, fault_scale)
+
+            loss_forward = jax.checkpoint(
+                forward_sharded,
+                policy=jax.checkpoint_policies.save_any_names_but_these(
+                    'zero3_gather'))
+        else:
+            loss_forward = forward_loss
+
         guard_on = self._guard is not None
 
         def train_step(t_params, f_params, master, opt_state, inputs,
                        labels, key, lr, fault_scale):
             (loss_val, aux), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(t_params, f_params, inputs,
+                loss_forward, has_aux=True)(t_params, f_params, inputs,
                                             labels, key, fault_scale)
             new_params = {}
             new_master = {}
@@ -357,14 +533,24 @@ class ShardedTrainStep:
             ok = jnp.isfinite(loss_val) if guard_on else None
             for n in t_names:
                 g32 = grads[n].astype(jnp.float32)
-                if guard_on:
-                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g32)))
+                fz = flat_meta.get(n)
                 zsh = shard_constraint.get(n)
-                if zsh is not None:
+                if fz is not None:
+                    # ragged param (ZeRO-3 flatten+pad): the grad
+                    # flattens and zero-pads into the flat 1/dp layout
+                    g32 = jnp.pad(g32.reshape(-1), (0, fz['pad']))
+                    g32 = jax.lax.with_sharding_constraint(
+                        g32, zero_shardings[n])
+                elif zsh is not None:
                     # reduce-scatter: the grad is only ever consumed in
                     # this dp-sharded layout, so the partitioner combines
                     # the backward psum + slice into one reduce-scatter
                     g32 = jax.lax.with_sharding_constraint(g32, zsh)
+                if guard_on:
+                    # isfinite over the SHARDED grad, pre-gather: each
+                    # device reduces its 1/dp slice and GSPMD psums the
+                    # scalar over dp — never a full-grad rebuild
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g32)))
                 if n in master_names:
                     p32 = master[n]
                 else:
@@ -372,9 +558,16 @@ class ShardedTrainStep:
                     if zsh is not None:
                         p32 = jax.lax.with_sharding_constraint(p32, zsh)
                 np_, ns_ = opt_update(p32, g32, opt_state[n], lr, **opt_kwargs)
-                new_params[n] = np_.astype(t_params[n].dtype)
-                if n in master_names:
+                if fz is not None:
+                    # updated flat master -> refresh the replicated
+                    # logical compute-dtype copy (slice off the pad)
+                    new_params[n] = np_[:fz['size']].reshape(
+                        shapes[n]).astype(t_params[n].dtype)
                     new_master[n] = np_
+                else:
+                    new_params[n] = np_.astype(t_params[n].dtype)
+                    if n in master_names:
+                        new_master[n] = np_
                 new_state[n] = ns_
             new_f = {n: aux.get(n, f_params[n]) for n in f_names}
             if guard_on:
@@ -419,26 +612,49 @@ class ShardedTrainStep:
         self._batch_sh = batch_sh
         self._zero_shardings = zero_shardings
         self._state_shardings = state_shardings
+        self._flat_meta = flat_meta
         # Per-step collective accounting (mxnet_tpu_comm_* contract):
-        # ring-algorithm wire bytes per device, so ZeRO provably moves the
-        # SAME total as the replicated path — all_reduce(N) costs
+        # ring-algorithm wire bytes per device — all_reduce(N) costs
         # 2*(dp-1)/dp*N while reduce_scatter(N)+all_gather(N) cost
-        # (dp-1)/dp*N each. Analytic (XLA does not expose per-collective
-        # byte counters), recorded once per step in __call__.
+        # (dp-1)/dp*N each, so ZeRO-1 provably moves the SAME total as
+        # the replicated path. ZeRO-3 is honestly MORE: each dim-sharded
+        # param all-gathers twice per step (forward use + backward
+        # regather under the remat policy) in the compute dtype, and its
+        # fp32 grad reduce-scatters once; flat params reduce-scatter the
+        # padded fp32 grad and gather the updated flat master back to
+        # the replicated logical copy. Analytic (XLA does not expose
+        # per-collective byte counters), recorded once per step in
+        # __call__, per-layer in self._gather_plan.
         dp = self._dp_size
         ring = (dp - 1) / dp if dp > 1 else 0.0
         plan = {}
+
+        def _add(kind, nbytes, cnt):
+            b, c = plan.get(kind, (0.0, 0))
+            plan[kind] = (b + nbytes, c + cnt)
+
+        param_nbytes = {}
         for n, p in trainable:
             size = int(onp.prod(p.data().shape)) if p.data().shape else 1
             nbytes = size * jnp.dtype(p.data()._data.dtype).itemsize
-            if zero_specs[n] is not None:
+            param_nbytes[n] = nbytes
+            fz = flat_meta.get(n)
+            if stage3 and n in gather_ns:
+                _add('all_gather', 2 * ring * nbytes, 2)
+                _add('reduce_scatter', ring * size * 4, 1)
+            elif fz is not None:
+                _add('reduce_scatter', ring * fz['padded'] * 4, 1)
+                _add('all_gather', ring * fz['padded'] * 4, 1)
+            elif zero_specs[n] is not None:
                 for kind in ('reduce_scatter', 'all_gather'):
-                    b, c = plan.get(kind, (0.0, 0))
-                    plan[kind] = (b + ring * nbytes, c + 1)
+                    _add(kind, ring * nbytes, 1)
             elif dp > 1:
-                b, c = plan.get('all_reduce', (0.0, 0))
-                plan['all_reduce'] = (b + 2 * ring * nbytes, c + 1)
+                _add('all_reduce', 2 * ring * nbytes, 1)
         self._comm_plan = plan
+        # per-layer gather bytes (zero3): [(layer, bytes/step, gathers)]
+        self._gather_plan = [
+            (gname, 2 * ring * sum(param_nbytes[n] for n in names), 2)
+            for gname, names in layer_groups]
 
     # ------------------------------------------------------------------
     def init(self, *example_inputs):
@@ -498,8 +714,9 @@ class ShardedTrainStep:
                     p._data[0]._data = _put_replicated(
                         p.data()._data, self._f_shardings[n])
                 self._master = {
-                    n: _put_replicated(p.data()._data.astype(jnp.float32),
-                                       self._master_shardings[n])
+                    n: _put_replicated(
+                        self._master_host(n, p.data()._data),
+                        self._master_shardings[n])
                     for n, p in self._trainable
                     if n in self._master_names}
                 self._opt_state = {
@@ -515,6 +732,9 @@ class ShardedTrainStep:
                 _telemetry.set_gauge(
                     'mxnet_tpu_comm_opt_state_bytes_per_device',
                     self.opt_state_bytes_per_device())
+                _telemetry.set_gauge(
+                    'mxnet_tpu_comm_param_bytes_per_device',
+                    self.param_bytes_per_device())
 
         t_params = {n: p.data()._data for n, p in self._trainable}
         f_params = {n: p.data()._data for n, p in self._frozen}
@@ -554,18 +774,27 @@ class ShardedTrainStep:
         self._step_count += 1
         if self._comm_plan and _trace.enabled():
             # the collectives run INSIDE the compiled program — annotate
-            # the trace with the analytic ring-wire plan per step
+            # the trace with the analytic ring-wire plan per step; the
+            # stage label separates the zero1 writeback gather from the
+            # zero3 per-layer on-use gathers
             for kind, (nbytes, count) in self._comm_plan.items():
                 _trace.instant(f'comm.{kind}', bytes=int(nbytes),
-                               count=count, axis=self.dp_axis)
+                               count=count, axis=self.dp_axis,
+                               stage=self._zero_label)
+            for layer, nbytes, count in self._gather_plan:
+                _trace.instant('comm.all_gather', bytes=int(nbytes),
+                               count=count, axis=self.dp_axis,
+                               stage=self._zero_label, layer=layer)
         if _telem['on'] and self._comm_plan:
             from .. import telemetry as _telemetry
             for kind, (nbytes, count) in self._comm_plan.items():
                 _telemetry.counter(
                     'mxnet_tpu_comm_collective_bytes_total').inc(
-                        nbytes, kind=kind, axis=self.dp_axis)
+                        nbytes, kind=kind, axis=self.dp_axis,
+                        stage=self._zero_label)
                 _telemetry.counter('mxnet_tpu_comm_collectives_total').inc(
-                    count, kind=kind, axis=self.dp_axis)
+                    count, kind=kind, axis=self.dp_axis,
+                    stage=self._zero_label)
         loss_nd = NDArray(_local_value(loss))
         _flight.record_step(self._step_count, loss=loss_nd)
         return loss_nd
@@ -604,17 +833,75 @@ class ShardedTrainStep:
             return None
         return _attribution.xla_cost(compiled)
 
+    def _master_host(self, n, arr):
+        """Host-side fp32 master for param ``n`` in its PERSISTENT
+        layout: logical shape, or flattened + zero-padded to the dp
+        multiple for ZeRO-3 flat params."""
+        a = onp.asarray(arr, onp.float32)
+        fz = getattr(self, '_flat_meta', {}).get(n)
+        if fz is not None:
+            a = onp.pad(a.reshape(-1), (0, fz['pad']))
+        return a
+
+    def _leaf_to_logical(self, n, a):
+        """Un-flatten a ZeRO-3 flat master/moment back to the param's
+        logical shape for the layout-independent states payload."""
+        a = onp.asarray(a)
+        fz = getattr(self, '_flat_meta', {}).get(n)
+        if fz is not None and a.ndim == 1 and a.shape[0] == fz['padded']:
+            a = a[:fz['size']].reshape(self._shapes[n])
+        return a
+
+    def _leaf_from_logical(self, n, a):
+        """Flatten+pad a logical-shape restored master/moment into this
+        step's ZeRO-3 flat layout (identity elsewhere, and for the
+        shape-() step counters)."""
+        a = onp.asarray(a)
+        fz = getattr(self, '_flat_meta', {}).get(n)
+        if fz is not None and a.shape == self._shapes[n]:
+            a = onp.pad(a.reshape(-1).astype(onp.float32, copy=False),
+                        (0, fz['pad']))
+        return a
+
     def opt_state_bytes_per_device(self):
-        """Bytes of optimizer state (masters + moments) ONE device holds.
-        Under ZeRO-1 this is ~1/dp of the replicated footprint (± the
-        tensors too small/ragged to shard)."""
+        """Bytes of optimizer state (masters + moments) ONE device holds
+        — physical ``addressable_shards`` bytes, so ZeRO-3 flat pad
+        bytes are included (the per-param breakdown is on
+        ``self.opt_state_pad_bytes`` after the first step). Under ZeRO
+        this is ~1/dp of the replicated footprint (± the tensors too
+        small to shard)."""
         total = 0
         for st in (self._opt_state or {}).values():
             for s in st:
-                total += s.addressable_shards[0].data.nbytes
+                total += device_nbytes(s)
         for m in (self._master or {}).values():
-            total += m.addressable_shards[0].data.nbytes
+            total += device_nbytes(m)
+        # pad-to-divisible slack of the zero3 flat stores, per device:
+        # pad elements * fp32 * (1 master + moment leaves) / dp
+        pad = 0
+        for n, fz in getattr(self, '_flat_meta', {}).items():
+            leaves = 1 + sum(1 for s in self._opt_state[n] if s.ndim)
+            pad += fz['pad'] * 4 * leaves // self._dp_size
+        self.opt_state_pad_bytes = pad
         return total
+
+    def param_bytes_per_device(self):
+        """Bytes of the persistent parameters (trainable + frozen, in
+        compute dtype) ONE device holds — under ZeRO-3 the dim-sharded
+        params count their 1/dp shard. Masters are accounted by
+        ``opt_state_bytes_per_device``; the two sum to the persistent
+        model footprint per device."""
+        total = 0
+        for _n, p in (self._trainable or []) + (self._frozen or []):
+            total += device_nbytes(p.data()._data)
+        return total
+
+    def gather_bytes_per_step(self):
+        """Total analytic ring-wire bytes of the ZeRO-3 per-layer
+        param gathers ONE step moves (sum of ``self._gather_plan``;
+        0 outside stage 3)."""
+        return int(sum(b for _l, b, _c in
+                       getattr(self, '_gather_plan', None) or []))
 
     def get_states_bytes(self):
         """Optimizer state as a layout-independent bytes payload: every
@@ -631,14 +918,18 @@ class ShardedTrainStep:
                 return pickle.dumps(self._pending_states)
             raise MXNetError("get_states_bytes: no optimizer state yet — "
                              "run at least one step first")
-        states = {n: tuple(onp.asarray(s) for s in st)
+        # every leaf gathers to host in LOGICAL shape (zero3 flat
+        # stores un-flatten), so the payload restores at any dp/stage
+        states = {n: tuple(self._leaf_to_logical(n, s) for s in st)
                   for n, st in self._opt_state.items()}
-        master = {n: onp.asarray(m) for n, m in self._master.items()}
+        master = {n: self._leaf_to_logical(n, m)
+                  for n, m in self._master.items()}
         return pickle.dumps({
             'format': 'sharded_train_step_v1',
             'opt_state': states, 'master': master,
             'step_count': self._step_count,
-            'zero': self.zero, 'dp': self._dp_size})
+            'zero': self.zero, 'stage': self.zero_stage,
+            'dp': self._dp_size})
 
     def set_states_bytes(self, blob):
         """Restore a get_states_bytes() payload, scattering each tensor
@@ -661,10 +952,22 @@ class ShardedTrainStep:
                 raise MXNetError(f"set_states_bytes: unknown parameter "
                                  f"{n!r} in restored optimizer state")
             self._opt_state[n] = tuple(
-                _put_replicated(onp.asarray(s), sh)
+                _put_replicated(self._leaf_from_logical(n, s), sh)
                 for s, sh in zip(st, self._state_shardings[n]))
-        for n, m in doc.get('master', {}).items():
+        restored_master = doc.get('master', {})
+        for n, m in restored_master.items():
             if n in self._master_names:
                 self._master[n] = _put_replicated(
-                    onp.asarray(m), self._master_shardings[n])
+                    self._leaf_from_logical(n, m),
+                    self._master_shardings[n])
+        # zero3 flat masters with no saved counterpart (payload written
+        # under zero off/1, where the param carried the value itself):
+        # reseed from the CURRENT param so the flat store matches the
+        # restored weights instead of keeping a pre-restore value
+        for n, p in self._trainable or []:
+            if n in self._flat_meta and n not in restored_master \
+                    and n in self._master_names:
+                self._master[n] = _put_replicated(
+                    self._master_host(n, onp.asarray(p.data()._data)),
+                    self._master_shardings[n])
         self._step_count = int(doc.get('step_count', self._step_count))

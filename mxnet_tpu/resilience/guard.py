@@ -10,6 +10,11 @@ guard closes that hole in three layers:
    into one scalar flag, and gates the weight/optimizer-state outputs
    with ``where(finite, new, old)`` — a non-finite step is a no-op ON
    DEVICE, inside the same XLA program, before the host ever knows.
+   Under ZeRO the reduction runs over the SHARDED (reduce-scattered)
+   gradients before any gather — each device scans its 1/dp slice and
+   GSPMD psums the scalar flag over dp — and the gate writes back the
+   sharded masters/params in place, so the guard composes with ZeRO-1
+   and ZeRO-3 at 1/dp cost and zero extra full-tensor traffic.
 2. **Deferred host check (no extra sync)**: the flag is a device scalar
    the guard reads at the START of the next step, when the previous
    step's program has long finished — the happy path never blocks on an

@@ -93,7 +93,8 @@ def xla_cost(compiled):
 
 
 def report(steps, flops_per_step=None, bytes_per_step=None,
-           peak_flops=None, collective_bytes=None, skip_first=1):
+           peak_flops=None, collective_bytes=None, gather_layers=None,
+           skip_first=1):
     """Attribution over flight-recorder step records.
 
     ``steps`` — ``flight.get().steps()`` (each record carries
@@ -164,6 +165,13 @@ def report(steps, flops_per_step=None, bytes_per_step=None,
         # only host-visible number for them
         out['collective_bytes_per_step'] = {
             k: int(v) for k, v in collective_bytes.items()}
+    if gather_layers:
+        # ZeRO-3 per-layer all-gather plan [(layer, bytes/step, count)]:
+        # the unit of gather-vs-compute overlap the latency-hiding
+        # scheduler works with (matches the comm.all_gather trace
+        # instants' `layer` arg)
+        out['gather_bytes_per_layer'] = {
+            str(layer): int(nbytes) for layer, nbytes, _c in gather_layers}
     losses = [r['loss'] for r in used if r.get('loss') is not None]
     if losses:
         out['loss_last'] = losses[-1]

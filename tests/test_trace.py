@@ -333,7 +333,7 @@ def test_watchdog_stall_on_injected_hang_dumps_flight(tmp_path, monkeypatch):
     step.dispatch:hang), the watchdog notices the missing heartbeat and
     dumps the flight recorder — the post-mortem JSON names the faulting
     step's spans, including the still-OPEN step.dispatch scope."""
-    monkeypatch.setenv('MXTPU_FAULT_HANG_SECONDS', '1.5')
+    monkeypatch.setenv('MXTPU_FAULT_HANG_SECONDS', '6.0')
     monkeypatch.setenv('MXTPU_FLIGHT_PATH', str(tmp_path / 'flight.json'))
     trace.enable()
 
@@ -362,10 +362,22 @@ def test_watchdog_stall_on_injected_hang_dumps_flight(tmp_path, monkeypatch):
     with wd:
         wd.beat(1)
         t.start()
-        deadline = time.monotonic() + 5.0
+        # Feed the watchdog until the worker is provably wedged inside the
+        # step.dispatch span, so the stall clock only starts ticking while
+        # the hang window is open (a loaded machine can otherwise delay the
+        # worker past the deadline before it even reaches the span).
+        entered = time.monotonic() + 15.0
+        while time.monotonic() < entered and not any(
+                s['name'] == 'step.dispatch' for s in trace.open_spans()):
+            wd.beat(1)
+            time.sleep(0.02)
+        assert any(s['name'] == 'step.dispatch'
+                   for s in trace.open_spans()), \
+            "worker never entered the step.dispatch span"
+        deadline = time.monotonic() + 15.0
         while not reports and time.monotonic() < deadline:
             time.sleep(0.02)
-    t.join(timeout=10.0)
+    t.join(timeout=20.0)
     assert reports, "watchdog never fired on the hung step"
     path = tmp_path / 'flight.json'
     assert path.exists(), "stall did not dump the flight recorder"

@@ -124,8 +124,15 @@ def test_compose_zero_spec_rules():
     assert compose_zero_spec((32,), P(), 'dp', 8) == P('dp')
     # too small to shard -> stays replicated (the ragged/padding slack)
     assert compose_zero_spec((3,), P(), 'dp', 8) is None
-    # uneven-but-large dim still shards (padded shards)
-    assert compose_zero_spec((12,), P(), 'dp', 8) == P('dp')
+    # uneven-but-large dims no longer shard raggedly: this jax refuses
+    # uneven NamedShardings, so they stay replicated here (ZeRO-3
+    # recovers them via flatten+pad — see zero3_layout) ...
+    assert compose_zero_spec((12,), P(), 'dp', 8) is None
+    # ... and a spec that itself PROPOSES dp on a non-divisible dim is
+    # rejected up front with a clear error instead of deferring to an
+    # opaque XLA refusal at device_put time
+    with pytest.raises(MXNetError, match='not divisible'):
+        compose_zero_spec((12, 16), P('dp', None), 'dp', 8)
     assert compose_zero_spec((), P(), 'dp', 8) is None
 
 
@@ -186,11 +193,13 @@ def test_zero1_comm_telemetry_accounting():
         telemetry.reset()
         _, step_z, _ = _run_step('adamw', mesh, zero=True, steps=2)
         rs = telemetry.value('mxnet_tpu_comm_collective_bytes_total',
-                             kind='reduce_scatter', axis='dp')
+                             kind='reduce_scatter', axis='dp',
+                             stage='zero1')
         ag = telemetry.value('mxnet_tpu_comm_collective_bytes_total',
-                             kind='all_gather', axis='dp')
+                             kind='all_gather', axis='dp', stage='zero1')
         n_rs = telemetry.value('mxnet_tpu_comm_collectives_total',
-                               kind='reduce_scatter', axis='dp')
+                               kind='reduce_scatter', axis='dp',
+                               stage='zero1')
         gauge_z = telemetry.value(
             'mxnet_tpu_comm_opt_state_bytes_per_device')
         assert rs and ag and rs == ag
@@ -200,11 +209,12 @@ def test_zero1_comm_telemetry_accounting():
         telemetry.reset()
         _, step_r, _ = _run_step('adamw', mesh, zero=False, steps=2)
         ar = telemetry.value('mxnet_tpu_comm_collective_bytes_total',
-                             kind='all_reduce', axis='dp')
+                             kind='all_reduce', axis='dp', stage='off')
         gauge_r = telemetry.value(
             'mxnet_tpu_comm_opt_state_bytes_per_device')
         assert telemetry.value('mxnet_tpu_comm_collective_bytes_total',
-                               kind='reduce_scatter', axis='dp') is None
+                               kind='reduce_scatter', axis='dp',
+                               stage='off') is None
         assert ar == rs + ag   # same total traffic, different decomposition
         assert gauge_r >= 4 * gauge_z   # ~8x minus replicated scalars
     finally:
@@ -241,7 +251,8 @@ def test_zero1_checkpoint_dp8_to_dp4_bit_parity(tmp_path):
     from mxnet_tpu.checkpoint import manifest as mf
     doc = mf.read_manifest(mgr.step_dir(3))
     layout = doc['metadata']['optimizer_state_layout']
-    assert layout == {'format': 'gathered-host', 'zero1': True, 'dp': 8}
+    assert layout == {'format': 'gathered-host', 'zero1': True,
+                      'stage': 1, 'dp': 8}
 
     # reference trajectory: one MORE step on the saving instance (before
     # any restore mutates the shared net's params)

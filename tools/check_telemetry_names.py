@@ -82,16 +82,25 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_resilience_worker_respawns_total': 'counter',
     },
     'mxnet_tpu_comm_': {
-        # collective traffic accounting (ZeRO-1 / GSPMD dp path):
+        # collective traffic accounting (ZeRO / GSPMD dp path):
         # ring-algorithm wire bytes per device by collective kind
         # (reduce_scatter / all_gather / all_reduce / broadcast /
-        # state_scatter) and mesh axis — ZeRO must show the SAME total
-        # bytes as the replicated update while the optimizer-state gauge
-        # drops to ~1/dp
+        # state_scatter / param_scatter) and mesh axis. The GSPMD step
+        # counters additionally carry a `stage` label (off / zero1 /
+        # zero3) separating the ZeRO-1 writeback gather from the ZeRO-3
+        # per-layer on-use gathers: ZeRO-1 must show the SAME total
+        # bytes as the replicated update while the optimizer-state
+        # gauge drops to ~1/dp; ZeRO-3 adds the param regather wire
+        # bytes while the param gauge also drops to ~1/dp. The per-step
+        # trace instants (`comm.all_gather`) carry per-layer bytes via
+        # a `layer` arg for gather-vs-compute overlap attribution.
         'mxnet_tpu_comm_collective_bytes_total': 'counter',
         'mxnet_tpu_comm_collectives_total': 'counter',
         # optimizer state (fp32 masters + moments) held by ONE device
         'mxnet_tpu_comm_opt_state_bytes_per_device': 'gauge',
+        # persistent params (compute dtype) held by ONE device — the
+        # ZeRO-3 1/dp param residency is auditable against it
+        'mxnet_tpu_comm_param_bytes_per_device': 'gauge',
     },
     'mxnet_tpu_trace_': {
         # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
