@@ -329,6 +329,23 @@ class CheckpointManager:
             from .. import random as _random
             rng = _random.get_state()
         meta = dict(metadata or {})
+        # elastic resumes are auditable: record the world this step was
+        # committed UNDER (jax process world + side-channel membership
+        # view when one is running). The payloads themselves are
+        # host-gathered, so ANY survivor set can restore them — this is
+        # bookkeeping, not a restore constraint.
+        try:
+            import jax as _jax
+            world = {'processes': int(_jax.process_count()),
+                     'rank': int(_jax.process_index())}
+            from ..parallel import dist as _dist
+            ms = _dist.membership()
+            if ms is not None:
+                world['membership'] = {'alive': ms.alive(),
+                                       'world': ms.world_size()}
+            meta.setdefault('world', world)
+        except Exception:
+            pass
         if 'trainer_states' in blobs and self._trainer is not None:
             # The states payload is ALWAYS host-gathered fp32 (both
             # Trainer.get_states_bytes and ShardedTrainStep gather their

@@ -213,6 +213,38 @@ register('MXNET_TPU_IO_CORRUPT_POLICY', str, 'error',
          "mid-epoch: 'error' raises DataError naming the record index "
          "and file offset; 'skip' substitutes the next good record and "
          "counts mxnet_tpu_io_corrupt_records_total.")
+register('MXTPU_ELASTIC', _bool, False,
+         'Enable the elastic-training membership layer: dist.init() '
+         'starts the rank-0 heartbeat coordinator and a per-process '
+         'heartbeat sender on a side-channel TCP socket (never the ICI '
+         'collectives), so peer loss is detectable while a collective '
+         'is wedged. Pairs with resilience.ElasticController for the '
+         'commit -> re-form -> resume path.')
+register('MXTPU_ELASTIC_PORT', int, 0,
+         'TCP port of the elastic membership side channel on the '
+         'coordinator host. 0 (default) derives jax-coordinator port '
+         '+ 1000 so launch.py-style multi-job hosts do not collide.')
+register('MXTPU_HEARTBEAT_SECONDS', float, 1.0,
+         'Elastic membership heartbeat period. Each process beats the '
+         'rank-0 coordinator this often over the side channel '
+         '(piggybacking its last completed step).')
+register('MXTPU_PEER_DEADLINE_SECONDS', float, 10.0,
+         'Elastic membership peer deadline: a peer whose last heartbeat '
+         'is older than this is declared LOST — the survivors commit a '
+         'checkpoint, re-form the mesh at the new world size and '
+         'resume. Also the window after which a worker that cannot '
+         'reach the coordinator considers the coordinator itself lost.')
+register('MXTPU_DIST_INIT_RETRIES', int, 3,
+         'Bounded retries (exponential backoff) of '
+         'jax.distributed.initialize in dist.init() — workers that '
+         'start before the coordinator is listening see a transient '
+         'connection error, not a fatal one.')
+register('MXTPU_BARRIER_TIMEOUT_SECONDS', float, 60.0,
+         'Timeout of the elastic membership barrier (dist.barrier): '
+         'how long a rank waits for every live peer to arrive at the '
+         'same tag before raising.')
+
+
 def _zero_stage(s):
     """MXTPU_ZERO value -> ZeRO stage int: 0/off/false -> 0, 1/on/true
     -> 1, 3 -> 3 (stage 2 has no separate meaning on the GSPMD path —

@@ -62,6 +62,10 @@ class Trainer:
         # update then also reduces isfinite over every gradient and
         # skips the writeback ON DEVICE when the step is non-finite
         self._guard = None
+        # resilience.ElasticController bound via attach_elastic():
+        # step() then consults it first, so preemption/peer loss turns
+        # into commit -> re-form -> resume with the user loop unmodified
+        self._elastic = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -156,6 +160,14 @@ class Trainer:
                 # else: >20x the running step time is a pause (eval,
                 # checkpoint) or a recompile spike, not a step — keep it
                 # out of the histogram and the samples/sec + MFU gauges
+        if self._elastic is not None:
+            # preemption -> Preempted (final checkpoint committed);
+            # peer loss -> commit + mesh re-form + restore happened just
+            # now: the gradients in the param buffers were computed
+            # against pre-re-form weights, so this step's update is
+            # dropped and training resumes on the next batch
+            if self._elastic.pre_step() is not None:
+                return
         if not self._kv_initialized:
             self._init_kvstore()
         with _trace.span('step.dispatch'):
@@ -177,6 +189,11 @@ class Trainer:
             with _trace.span('optimizer.update'):
                 self._update(ignore_stale_grad)
         _flight.record_step(self._optimizer.num_update)
+        if self._elastic is not None:
+            # feed the controller's commit point (and the heartbeat's
+            # piggybacked step) — an elastic commit must capture THIS
+            # step, not the last cadence save
+            self._elastic.beat(self._optimizer.num_update)
 
     def attach_guard(self, guard):
         """Bind a ``resilience.NonFiniteGuard``. The fused update gains
@@ -188,6 +205,33 @@ class Trainer:
         self._guard = guard
         self._fused_cache = None
         self._fused_traced = False
+
+    def attach_elastic(self, controller):
+        """Bind a ``resilience.ElasticController``: every ``step()``
+        then consults it first (preemption -> ``Preempted`` after the
+        final commit; peer loss -> commit + re-form + restore, this
+        step's stale gradients dropped) and the controller re-forms this
+        trainer via ``_on_reform`` — user training loops run
+        unmodified."""
+        self._elastic = controller
+        controller.attach_trainer(self)
+        return controller
+
+    def _on_reform(self, mesh=None):
+        """Elastic re-form: the world size (and with it the dp degree
+        and ZeRO layout) just changed. Drop the fused-update cache and
+        the remembered ZeRO placement so the next step re-derives the
+        layout from wherever the restored weights now live; the
+        optimizer-state scatter re-runs there too (the restored states
+        payload is host-gathered, same as after set_states_bytes)."""
+        self._fused_cache = None
+        self._fused_traced = False
+        self._zero_active = False
+        self._zero_dp = 1
+        self._zero_stage = 0
+        self._zero3_mesh = mesh if mesh is not None and \
+            dict(getattr(mesh, 'shape', {})).get('dp', 0) > 1 else None
+        self.reset_step_timer()
 
     def _poison_grads(self):
         """Injected ``step.dispatch:nan`` fault: overwrite every gradient

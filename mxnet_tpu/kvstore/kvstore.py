@@ -154,6 +154,8 @@ class KVStore(KVStoreBase):
         return capability in ('optimizer',)
 
     def barrier(self):
+        from ..resilience import faults as _faults
+        _faults.fire('dist.barrier')
         from ..ndarray import waitall
         waitall()
 
@@ -198,6 +200,8 @@ class DistSync(KVStore):
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         nproc = jax.process_count()
+        if nproc > 1:
+            self._check_peers()
         for k, vlist in _group(keys, values):
             if _telem['on']:
                 _telem_push(k, vlist)
@@ -212,6 +216,30 @@ class DistSync(KVStore):
                 self._updater(_updater_key(k), merged, self._store[k])
             else:
                 self._store[k] = merged
+
+    @staticmethod
+    def _check_peers():
+        """Refuse to enter a cross-process reduction once the elastic
+        membership layer has declared a peer lost — a collective missing
+        a participant wedges forever; PeerLossError is recoverable
+        (commit + re-form via resilience.ElasticController)."""
+        from ..resilience.elastic import raise_if_peer_lost
+        raise_if_peer_lost()
+
+    def barrier(self):
+        """Membership-level barrier when the elastic side channel is up
+        (a rendezvous that skips lost/left peers instead of wedging),
+        device-drain otherwise. The ``dist.barrier`` fault site fires
+        exactly once either way (Membership.barrier carries its own)."""
+        from ..parallel import dist as _dist
+        ms = _dist.membership()
+        if ms is not None and jax.process_count() > 1:
+            ms.barrier('kvstore')
+        else:
+            from ..resilience import faults as _faults
+            _faults.fire('dist.barrier')
+        from ..ndarray import waitall
+        waitall()
 
     @property
     def rank(self):

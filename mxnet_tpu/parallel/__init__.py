@@ -7,7 +7,9 @@ pjit/shard_map; XLA emits the collectives over ICI/DCN.
 - mesh:        mesh construction helpers + global default mesh
 - collectives: axis-name bookkeeping + psum/all_gather wrappers
 - step:        compiled data/tensor-parallel training step builder
-- dist:        multi-process init (jax.distributed), launch.py analog
+- dist:        multi-process init (jax.distributed), launch.py analog,
+               elastic membership side channel (heartbeats, peer-loss
+               detection, re-form barrier — MXTPU_ELASTIC)
 - ring_attention: sequence-parallel ring attention over ppermute
 """
 from .mesh import (make_mesh, default_mesh, set_default_mesh, mesh_shape,

@@ -1,4 +1,4 @@
-"""Multi-process distributed init + launcher.
+"""Multi-process distributed init + launcher + elastic membership.
 
 Ref: tools/launch.py + dmlc tracker (scheduler/server/worker env bootstrap
 via DMLC_ROLE / DMLC_PS_ROOT_URI). TPU-native: `jax.distributed.initialize`
@@ -10,40 +10,250 @@ Env protocol (launch-compatible shape):
   MXNET_TPU_NUM_PROCS    total processes
   MXNET_TPU_PROC_ID      this process's rank
 (Also accepts the DMLC_* names for drop-in use of reference launch scripts.)
+
+Elastic membership (`MXTPU_ELASTIC=1`, ROADMAP item 4): the ps-lite
+tracker's worker-churn awareness has no analog in jax.distributed — a
+preempted host wedges every peer inside a collective until the job dies.
+The ``Membership`` layer closes that gap on a lightweight TCP side
+channel (NEVER the ICI collectives, which are exactly what a lost peer
+wedges): rank 0 runs a coordinator thread tracking per-peer heartbeat
+ages, every process runs a sender thread beating once per
+``MXTPU_HEARTBEAT_SECONDS``, and a peer silent for
+``MXTPU_PEER_DEADLINE_SECONDS`` is declared LOST — the signal
+``resilience.ElasticController`` turns into commit -> re-form -> resume.
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
+import socket
 import subprocess
 import sys
+import threading
+import time as _time
 
 import jax
 
+from ..base import MXNetError, telem_flags as _telem
+
+_log = logging.getLogger('mxnet_tpu.dist')
 
 _initialized = False
+_membership = None
+
+
+def _resolve_world(coordinator=None, num_processes=None, process_id=None,
+                   need_coordinator=True):
+    """One resolution of (coordinator, world, rank) from args/env —
+    shared by ``init()`` and ``start_membership()`` so the two can never
+    derive different coordinators (the membership side-channel port is
+    derived from the coordinator's). MXNET_TPU_* first, the DMLC_*
+    drop-in names next. The coordinator (and with it the
+    localhost-fallback warning) is only resolved when actually needed —
+    a single-process init has nobody to rendezvous with."""
+    num_processes = num_processes or int(os.environ.get(
+        'MXNET_TPU_NUM_PROCS', os.environ.get('DMLC_NUM_WORKER', '1')))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get('MXNET_TPU_PROC_ID',
+                       os.environ.get('DMLC_WORKER_ID', '0')))
+    if need_coordinator:
+        coordinator = coordinator \
+            or os.environ.get('MXNET_TPU_COORDINATOR') \
+            or _dmlc_coordinator()
+    return coordinator, int(num_processes), int(process_id)
 
 
 def init(coordinator=None, num_processes=None, process_id=None,
          local_device_ids=None):
-    """Initialize jax.distributed from args or env."""
+    """Initialize jax.distributed from args or env.
+
+    Transient "coordinator not yet listening" races (workers regularly
+    start before rank 0's service binds) get a bounded retry with
+    exponential backoff (``MXTPU_DIST_INIT_RETRIES``) instead of a fatal
+    error. With ``MXTPU_ELASTIC=1`` the membership side channel starts
+    here too (see ``Membership``)."""
     global _initialized
     if _initialized:
         return
-    coordinator = coordinator or os.environ.get(
-        'MXNET_TPU_COORDINATOR',
-        _dmlc_coordinator())
-    num_processes = num_processes or int(os.environ.get(
-        'MXNET_TPU_NUM_PROCS', os.environ.get('DMLC_NUM_WORKER', '1')))
-    process_id = process_id if process_id is not None else int(os.environ.get(
-        'MXNET_TPU_PROC_ID', os.environ.get('DMLC_WORKER_ID', '0')))
+    from .. import config as _config
+    _, num_processes, process_id = _resolve_world(
+        None, num_processes, process_id, need_coordinator=False)
+    elastic = bool(_config.get('MXTPU_ELASTIC'))
+    if num_processes > 1 or elastic:
+        # only now is a coordinator address needed (and only now may
+        # the localhost-fallback warning fire)
+        coordinator, _, _ = _resolve_world(
+            coordinator, num_processes, process_id)
+    if num_processes > 1:
+        from ..resilience.retry import retry_call
+        target = _initialize_once if elastic else \
+            jax.distributed.initialize
+
+        def _attempt(**kw):
+            # jaxlib surfaces BOTH transient connect races (grpc
+            # DEADLINE_EXCEEDED / UNAVAILABLE) and permanent mistakes
+            # as RuntimeError — classify, so a double init or bad
+            # argument fails immediately instead of burning the whole
+            # backoff budget behind misleading 'transient' warnings
+            try:
+                return target(**kw)
+            except RuntimeError as e:
+                if any(t in str(e) for t in
+                       ('only be called once', 'should be defined',
+                        'must be defined')):
+                    raise MXNetError(
+                        f"dist.init: non-transient "
+                        f"jax.distributed.initialize failure (not "
+                        f"retried): {e}") from e
+                raise
+
+        retry_call(
+            _attempt,
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            retries=_config.get('MXTPU_DIST_INIT_RETRIES'),
+            backoff_seconds=0.25,
+            retry_on=(RuntimeError, ConnectionError, OSError),
+            give_up_on=(MXNetError,),
+            site='dist.init')
+    _initialized = True
+    if _config.get('MXTPU_ELASTIC') and _membership is None:
+        start_membership(coordinator=coordinator,
+                         num_processes=num_processes,
+                         process_id=process_id)
+
+
+_elastic_client = False
+
+
+def _initialize_once(coordinator_address, num_processes, process_id,
+                     local_device_ids=None):
+    """Elastic-mode jax.distributed bring-up. Mirrors
+    jax._src.distributed.State.initialize but builds the client with the
+    knobs the stock wrapper does not expose:
+
+    - ``shutdown_on_destruction=False``: dropping the handle must not
+      enter the runtime's shutdown barrier — that barrier waits for
+      EVERY peer, the dead one included, which is exactly the wedge
+      elastic teardown escapes (``shutdown()`` above relies on this).
+    - ``shutdown_timeout=5``: if the orderly barrier IS entered (healthy
+      world), give up in seconds, not the 5-minute default.
+    """
+    from jax._src import config as _jax_config
+    from jax._src import distributed as _jd
+    from jax._src.lib import xla_extension
+    state = _jd.global_state
+    if state.client is not None:
+        return
+    if isinstance(local_device_ids, int):
+        local_device_ids = [local_device_ids]
+    if local_device_ids:
+        # same per-process device pinning stock initialize applies
+        visible = ','.join(str(x) for x in local_device_ids)
+        _jax_config.update('jax_cuda_visible_devices', visible)
+        _jax_config.update('jax_rocm_visible_devices', visible)
+    state.coordinator_address = coordinator_address
+    bind = '[::]:' + coordinator_address.rsplit(':', 1)[1]
+    if process_id == 0 and state.service is None:
+        state.service = xla_extension.get_distributed_runtime_service(
+            bind, num_processes)
+    state.num_processes = num_processes
+    state.process_id = process_id
+    global _elastic_client
+    client = xla_extension.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=300,
+        shutdown_timeout=5, shutdown_on_destruction=False,
+        use_compression=True)
+    client.connect()
+    state.client = client
+    _elastic_client = True
+    try:
+        state.initialize_preemption_sync_manager()
+    except Exception:
+        pass
+
+
+def shutdown(timeout=5.0):
+    """Tear down jax.distributed (elastic re-form path).
+
+    The runtime's orderly ``client.shutdown()`` is a BARRIER over every
+    peer — including the dead one — and blocks until they all arrive:
+    exactly the wedge elastic teardown exists to escape. So with a dead
+    peer the elastic path never enters it: the client handle (created
+    with ``shutdown_on_destruction=False`` by ``_initialize_once``) is
+    dropped, the coordination service is stopped on a daemon thread with
+    a bounded join (stopping it aborts the barrier server-side), and the
+    distributed bookkeeping is reset so ``process_count()`` and jax's
+    own atexit hook see a clean single-process state. Non-elastic
+    clients (stock ``jax.distributed.initialize``) still get the orderly
+    shutdown, also bounded. Returns True when the teardown completed
+    within ``timeout``."""
+    global _initialized
+    _initialized = False
+    try:
+        state = jax._src.distributed.global_state
+    except Exception:
+        return True
+    if state.client is None and state.service is None:
+        return True
+    # hand the live handles to the teardown thread in a box, then reset
+    # the bookkeeping FIRST: jax's atexit clean_up consults these same
+    # fields — once they are None it cannot re-enter the barrier
+    box = [state.client, state.service]
+    state.client = None
+    state.service = None
+    state.process_id = 0
+    state.num_processes = 1
+    state.preemption_sync_manager = None
+    state.coordinator_address = None
+    done = threading.Event()
+    elastic = _elastic_client
+
+    def _do():
+        client, service = box[0], box[1]
+        try:
+            if not elastic and client is not None:
+                client.shutdown()     # orderly barrier: healthy world
+            # elastic: NEVER enter the shutdown barrier (it waits for
+            # the dead peer) — drop the last client reference instead;
+            # shutdown_on_destruction=False makes the destructor stop
+            # the agent threads without any peer rendezvous, measured
+            # ~20 ms, after which the service stops cleanly
+            box[0] = client = None
+            if service is not None:
+                service.shutdown()
+        except Exception as e:
+            _log.warning("distributed teardown: %r", e)
+        finally:
+            box[1] = None
+            done.set()
+
+    threading.Thread(target=_do, daemon=True,
+                     name='mxtpu-dist-shutdown').start()
+    if not done.wait(timeout):
+        _log.warning(
+            "distributed teardown did not finish within %.1fs; "
+            "abandoning it on a daemon thread (bookkeeping already "
+            "reset — survivors keep making progress)", timeout)
+        return False
+    return True
+
+
+def reinit(coordinator, num_processes, process_id,
+           local_device_ids=None):
+    """Re-initialize jax.distributed at a NEW world size (after
+    ``shutdown()``) — the re-form half of elastic training. World size 1
+    needs no distributed runtime at all."""
+    global _initialized
+    _initialized = False
     if num_processes <= 1:
         _initialized = True
         return
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               local_device_ids=local_device_ids)
-    _initialized = True
+    init(coordinator=coordinator, num_processes=num_processes,
+         process_id=process_id, local_device_ids=local_device_ids)
 
 
 def _dmlc_coordinator():
@@ -51,6 +261,12 @@ def _dmlc_coordinator():
     port = os.environ.get('DMLC_PS_ROOT_PORT', '9000')
     if uri:
         return f"{uri}:{port}"
+    _log.warning(
+        "dist.init: no coordinator address configured — looked for "
+        "MXNET_TPU_COORDINATOR, then DMLC_PS_ROOT_URI[:DMLC_PS_ROOT_PORT] "
+        "— falling back to localhost:12345 (fine single-host; multi-host "
+        "workers will hang at initialize until one of those env vars "
+        "names rank 0)")
     return 'localhost:12345'
 
 
@@ -60,6 +276,490 @@ def rank():
 
 def num_workers():
     return jax.process_count()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership side channel
+# ---------------------------------------------------------------------------
+
+def _elastic_port(coordinator=None):
+    """Side-channel port: MXTPU_ELASTIC_PORT, else jax coordinator port
+    + 1000 (keeps parallel jobs on one host from colliding)."""
+    from .. import config as _config
+    port = _config.get('MXTPU_ELASTIC_PORT')
+    if port:
+        return int(port)
+    base = 12345
+    coordinator = coordinator or os.environ.get('MXNET_TPU_COORDINATOR')
+    if coordinator and ':' in coordinator:
+        try:
+            base = int(coordinator.rsplit(':', 1)[1])
+        except ValueError:
+            pass
+    return base + 1000
+
+
+class Membership:
+    """Heartbeat-tracked peer membership over a TCP side channel.
+
+    Rank 0 is the membership coordinator: a server thread answers one
+    JSON line per connection (``{'op': 'beat'|'leave'|'view'|'barrier',
+    'rank': r, ...}``) with the current view (``{'world', 'alive',
+    'ages', 'lost', 'left'}``). Every rank — 0 included — runs a sender
+    thread that beats once per ``heartbeat_seconds`` (rank 0 short-
+    circuits to a local state update so the coordinator never depends on
+    its own socket). A peer whose heartbeat age exceeds
+    ``deadline_seconds`` is LOST; a peer that said goodbye (``leave()``,
+    the SIGTERM path) is LEFT — departed but not a failure.
+
+    The side channel is deliberately not the collective fabric: a peer
+    wedged inside an ICI collective still heartbeats (the sender is a
+    daemon thread), while a SIGKILLed/preempted peer goes silent on both
+    — which is exactly the distinction the stall classifier needs
+    (``resilience.elastic.stall_verdict``)."""
+
+    def __init__(self, rank, world, coordinator_host='127.0.0.1',
+                 port=None, heartbeat_seconds=None, deadline_seconds=None,
+                 start=True):
+        from .. import config as _config
+        self.rank = int(rank)
+        self.world = int(world)
+        self.coordinator_host = coordinator_host
+        self.port = int(port) if port else _elastic_port()
+        self.heartbeat_seconds = float(
+            heartbeat_seconds if heartbeat_seconds is not None
+            else _config.get('MXTPU_HEARTBEAT_SECONDS'))
+        self.deadline_seconds = float(
+            deadline_seconds if deadline_seconds is not None
+            else _config.get('MXTPU_PEER_DEADLINE_SECONDS'))
+        self.is_coordinator = self.rank == 0
+        self.current_step = None      # piggybacked on each beat
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._server = None
+        # coordinator state (rank 0)
+        now = _time.monotonic()
+        self._last_beat = {r: now for r in range(self.world)}
+        self._steps = {}
+        self._left = set()
+        self._barriers = {}           # tag -> {rank: nonce} arrived this gen
+        self._barrier_gen = {}        # tag -> completed-rendezvous count
+        self._barrier_done = {}       # tag -> {rank: (nonce, gen)} latest
+        self._barrier_calls = 0
+        # sender-side state (every rank)
+        self._view = None             # last view dict from the coordinator
+        self._last_ok = now           # last successful beat round-trip
+        self.send_failures = 0
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        # restartable: stop()/leave() set the event — a re-start (or a
+        # become_coordinator promotion) must not spawn threads that see
+        # it still set and exit on their first wait
+        self._stop.clear()
+        if self.is_coordinator and self._server is None:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(('', self.port))
+            srv.listen(16)
+            srv.settimeout(0.2)
+            self._server = srv
+            t = threading.Thread(target=self._serve, daemon=True,
+                                 name='mxtpu-membership-coord')
+            t.start()
+            self._threads.append(t)
+        if not getattr(self, '_beating', False):
+            self._beating = True
+            t = threading.Thread(target=self._beat_loop, daemon=True,
+                                 name='mxtpu-membership-beat')
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=max(1.0, 2 * self.heartbeat_seconds))
+        self._threads = []
+        self._beating = False
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- coordinator server (rank 0) ---------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(1.0)
+                with conn, conn.makefile('rwb') as f:
+                    line = f.readline()
+                    if not line:
+                        continue
+                    reply = self._handle(json.loads(line.decode()))
+                    f.write(json.dumps(reply).encode() + b'\n')
+                    f.flush()
+            except (OSError, ValueError):
+                continue
+
+    def _handle(self, msg):
+        op = msg.get('op')
+        r = int(msg.get('rank', -1))
+        with self._lock:
+            if op == 'beat':
+                self._last_beat[r] = _time.monotonic()
+                if msg.get('step') is not None:
+                    self._steps[r] = int(msg['step'])
+            elif op == 'leave':
+                self._left.add(r)
+            elif op in ('barrier', 'barrier_poll'):
+                # generation-counted rendezvous: a reused tag (kvstore's
+                # fixed 'kvstore', repeated re-forms) must synchronize
+                # EVERY time, so completion bumps the tag's generation
+                # and clears the arrival set instead of leaving a
+                # permanently-satisfied one behind. Arrivals carry a
+                # per-call nonce so a RETRY whose original reply was
+                # lost after the rendezvous completed is recognized
+                # (replied done) instead of counting toward — and then
+                # waiting forever on — the NEXT generation.
+                tag = str(msg.get('tag', ''))
+                nonce = msg.get('nonce')
+                arrived = self._barriers.setdefault(tag, {})  # r -> nonce
+                done = self._barrier_done.setdefault(tag, {})
+                gen0 = self._barrier_gen.setdefault(tag, 0)
+                if op == 'barrier':
+                    prev = done.get(r)
+                    if prev is not None and prev[0] == nonce:
+                        gen0 = prev[1] - 1   # this call already completed
+                    else:
+                        arrived[r] = nonce
+                view = self._view_locked()
+                if arrived and set(view['alive']) <= \
+                        set(arrived) | self._left:
+                    self._barrier_gen[tag] = self._barrier_gen[tag] + 1
+                    for rr, nn in arrived.items():
+                        done[rr] = (nn, self._barrier_gen[tag])
+                    arrived.clear()
+                view['barrier_gen'] = self._barrier_gen[tag]
+                view['barrier_baseline'] = gen0
+                view['barrier_done'] = self._barrier_gen[tag] > gen0
+                return view
+            elif op == 'remove':
+                for x in msg.get('ranks', []):
+                    self._left.add(int(x))
+            return self._view_locked()
+
+    def _view_locked(self):
+        now = _time.monotonic()
+        ages = {str(r): round(now - t, 3)
+                for r, t in self._last_beat.items() if r not in self._left}
+        lost = sorted(int(r) for r, age in ages.items()
+                      if age > self.deadline_seconds)
+        alive = sorted(int(r) for r in ages if int(r) not in lost)
+        return {'world': len(alive), 'alive': alive, 'ages': ages,
+                'lost': lost, 'left': sorted(self._left),
+                'steps': {str(k): v for k, v in self._steps.items()}}
+
+    # -- sender (every rank) -----------------------------------------------
+
+    def _beat_loop(self):
+        from ..resilience import faults as _faults
+        while not self._stop.wait(self.heartbeat_seconds):
+            try:
+                # the fault site: raise drops this beat (enough in a row
+                # and the coordinator declares us lost), hang delays it
+                _faults.fire('dist.heartbeat')
+                self.beat()
+            except MXNetError:
+                pass    # _request already counted the send failure
+            except Exception:
+                with self._lock:
+                    self.send_failures += 1
+
+    def beat(self, step=None):
+        """One heartbeat round-trip (the sender thread's body; callable
+        directly from tests and training loops). Updates the cached
+        membership view."""
+        if step is not None:
+            self.current_step = int(step)
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_elastic_heartbeats_total')
+        msg = {'op': 'beat', 'rank': self.rank, 'step': self.current_step}
+        if self.is_coordinator:
+            view = self._handle(msg)
+            with self._lock:
+                self._view = view
+                self._last_ok = _time.monotonic()
+            return view
+        return self._request(msg)
+
+    def _request(self, msg, timeout=None):
+        timeout = timeout if timeout is not None else \
+            max(1.0, self.heartbeat_seconds * 2)
+        try:
+            with socket.create_connection(
+                    (self.coordinator_host, self.port),
+                    timeout=timeout) as conn:
+                with conn.makefile('rwb') as f:
+                    f.write(json.dumps(msg).encode() + b'\n')
+                    f.flush()
+                    line = f.readline()
+            view = json.loads(line.decode())
+        except (OSError, ValueError) as e:
+            with self._lock:
+                self.send_failures += 1
+            raise MXNetError(
+                f"membership: coordinator "
+                f"{self.coordinator_host}:{self.port} unreachable: "
+                f"{e!r}") from e
+        with self._lock:
+            self._view = view
+            self._last_ok = _time.monotonic()
+        return view
+
+    # -- queries -----------------------------------------------------------
+
+    def view(self):
+        """Latest membership view (coordinator: computed live; workers:
+        the last beat's reply)."""
+        if self.is_coordinator:
+            with self._lock:
+                return self._view_locked()
+        with self._lock:
+            return dict(self._view) if self._view else None
+
+    def lost_peers(self):
+        """Ranks declared lost. On a worker whose COORDINATOR has gone
+        silent past the deadline, that is rank 0 — the worker-side half
+        of the failure detector."""
+        v = self.view()
+        lost = list(v['lost']) if v else []
+        if not self.is_coordinator:
+            with self._lock:
+                coord_age = _time.monotonic() - self._last_ok
+            if coord_age > self.deadline_seconds and 0 not in lost:
+                lost.append(0)
+        return sorted(r for r in lost if r != self.rank)
+
+    def peer_ages(self):
+        """{rank: seconds-since-last-heartbeat} for the post-mortem
+        verdict (watchdog report / flight dump). Finite values only —
+        a retired coordinator (``remove_peers``) pins ``_last_ok`` to
+        inf, which must not leak -inf ages into JSON dumps."""
+        import math
+        v = self.view()
+        ages = {int(r): a for r, a in (v or {}).get('ages', {}).items()}
+        if not self.is_coordinator:
+            with self._lock:
+                age = _time.monotonic() - self._last_ok
+            if math.isfinite(age):
+                ages[0] = round(age, 3)
+        ages.pop(self.rank, None)
+        return ages
+
+    def alive(self):
+        """Sorted live ranks (self included unless it left)."""
+        v = self.view()
+        if not v:
+            return [self.rank]
+        alive = set(v['alive'])
+        if not self.is_coordinator:
+            alive -= set(self.lost_peers())
+            alive.add(self.rank)
+        return sorted(alive)
+
+    def world_size(self):
+        return len(self.alive())
+
+    # -- membership ops ----------------------------------------------------
+
+    def leave(self):
+        """Graceful goodbye (the SIGTERM/preemption path): peers see a
+        departure, not a failure."""
+        try:
+            if self.is_coordinator:
+                self._handle({'op': 'leave', 'rank': self.rank})
+            else:
+                self._request({'op': 'leave', 'rank': self.rank})
+        except MXNetError:
+            pass   # coordinator already gone — nothing to tell
+        self._stop.set()
+
+    def remove_peers(self, ranks):
+        """Retire lost peers from the tracked set (post re-form: the new
+        world must not keep re-declaring the same loss)."""
+        msg = {'op': 'remove', 'rank': self.rank,
+               'ranks': [int(r) for r in ranks]}
+        if self.is_coordinator:
+            self._handle(msg)
+        else:
+            try:
+                self._request(msg)
+            except MXNetError:
+                pass
+        # worker-side: absorb into the local view too (the coordinator
+        # itself may be among the removed) — pruning 'alive' and 'ages'
+        # as well, so a stale coordinator-produced view cannot resurrect
+        # a removed peer into the next survivor computation
+        rs = set(int(r) for r in ranks)
+        with self._lock:
+            if self._view:
+                self._view['lost'] = [r for r in self._view.get('lost', [])
+                                      if int(r) not in rs]
+                self._view['alive'] = [
+                    r for r in self._view.get('alive', [])
+                    if int(r) not in rs]
+                self._view['world'] = len(self._view['alive'])
+                for r in list(self._view.get('ages', {})):
+                    if int(r) in rs:
+                        self._view['ages'].pop(r)
+            if 0 in rs:
+                self._last_ok = float('inf')   # never re-declare rank 0
+
+    def retarget(self, host=None, port=None):
+        """Point this worker's sender at a NEW membership coordinator
+        (after the old one died and the lowest surviving rank promoted
+        itself via ``become_coordinator``). Without ``host`` the current
+        one is kept — correct when the survivors share it (single-host
+        drills); a multi-host deployment resolves the promoted rank's
+        address via ``ElasticController(coordinator_host_fn=...)``."""
+        with self._lock:
+            if host is not None:
+                self.coordinator_host = host
+            if port is not None:
+                self.port = int(port)
+            self._last_ok = _time.monotonic()
+        return self
+
+    def become_coordinator(self):
+        """Promote this rank to membership coordinator (lowest surviving
+        rank after the old coordinator died). Starts the server thread
+        on the same side-channel port, seeded with the current survivor
+        set."""
+        if self.is_coordinator:
+            return self
+        alive = self.alive()
+        with self._lock:
+            self.is_coordinator = True
+            now = _time.monotonic()
+            self._last_beat = {r: now for r in alive}
+            self._left = set()
+            self._last_ok = now
+        self.start()
+        return self
+
+    def barrier(self, tag, timeout=None):
+        """Membership-level rendezvous: block until every LIVE rank has
+        arrived at ``tag`` (left/lost peers are not waited for — that is
+        the point: a re-form barrier must not wait for the dead). Raises
+        MXNetError on timeout."""
+        from .. import config as _config
+        from ..resilience import faults as _faults
+        _faults.fire('dist.barrier')
+        timeout = timeout if timeout is not None else \
+            _config.get('MXTPU_BARRIER_TIMEOUT_SECONDS')
+        deadline = _time.monotonic() + float(timeout)
+        # arrive once; the reply's baseline is THIS rendezvous's
+        # generation — poll until the coordinator bumps past it (the
+        # bump clears the arrival set, so the same tag synchronizes
+        # again next time instead of staying permanently satisfied).
+        # Transient send failures retry within the deadline: a re-form
+        # barrier often races the PROMOTED coordinator's server start,
+        # and aborting on the first refused connection would kill a
+        # survivor mid-recovery. The nonce makes a retried arrival
+        # idempotent — a reply lost AFTER the rendezvous completed
+        # must read back as done, not as a fresh arrival.
+        with self._lock:
+            self._barrier_calls += 1
+            nonce = f'{self.rank}.{self._barrier_calls}'
+        msg = {'op': 'barrier', 'rank': self.rank, 'tag': str(tag),
+               'nonce': nonce}
+        view, baseline = None, None
+        while True:
+            try:
+                view = self._handle(msg) if self.is_coordinator \
+                    else self._request(msg)
+            except MXNetError:
+                view = None
+            if view is not None:
+                if baseline is None and msg['op'] == 'barrier':
+                    baseline = view.get('barrier_baseline', 0)
+                    msg = {'op': 'barrier_poll', 'rank': self.rank,
+                           'tag': str(tag)}
+                if view.get('barrier_gen', 0) > (baseline or 0):
+                    view['barrier_done'] = True
+                    return view
+            if _time.monotonic() > deadline:
+                raise MXNetError(
+                    f"membership barrier {tag!r} timed out after "
+                    f"{timeout}s: arrived ranks missing from alive set "
+                    f"{(view or {}).get('alive')}")
+            _time.sleep(min(0.05, self.heartbeat_seconds / 4))
+
+
+def membership():
+    """The process-global Membership (None unless started)."""
+    return _membership
+
+
+def start_membership(coordinator=None, num_processes=None, process_id=None,
+                     **kwargs):
+    """Start (or return) the process-global membership layer. Called by
+    ``init()`` under ``MXTPU_ELASTIC=1``; callable directly for custom
+    worlds (tests, drills)."""
+    global _membership
+    if _membership is not None:
+        return _membership
+    # the SAME resolution init() uses (one shared helper), so the
+    # derived side-channel port cannot diverge between init()-started
+    # and directly-started layers
+    coordinator, num_processes, process_id = _resolve_world(
+        coordinator, num_processes, process_id)
+    host = coordinator.rsplit(':', 1)[0] if ':' in coordinator \
+        else coordinator
+    kwargs.setdefault('port', _elastic_port(coordinator))
+    _membership = Membership(process_id, num_processes,
+                             coordinator_host=host, **kwargs)
+    return _membership
+
+
+def stop_membership():
+    global _membership
+    if _membership is not None:
+        _membership.stop()
+        _membership = None
+
+
+def barrier(tag='barrier', timeout=None):
+    """Module-level membership barrier (no-op without a membership —
+    single-process jobs have nobody to rendezvous with, but the fault
+    site still fires so drills stay deterministic)."""
+    if _membership is None:
+        from ..resilience import faults as _faults
+        _faults.fire('dist.barrier')
+        return None
+    return _membership.barrier(tag, timeout=timeout)
 
 
 def launch_local(script, n=2, env=None, coordinator='localhost:29500',

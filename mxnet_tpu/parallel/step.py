@@ -52,13 +52,40 @@ from .collectives import group_params_by_layer, ordered_barrier
 from .mesh import default_mesh
 
 
+def _devices_span_processes(devices):
+    """Does this device set include OTHER processes' devices? A
+    process-LOCAL placement (e.g. an elastic survivor training on its
+    own devices while jax.distributed is still initialized) must not
+    pay — or wedge inside — cross-process collectives."""
+    if jax.process_count() <= 1:
+        return False
+    try:
+        me = jax.process_index()
+        return any(d.process_index != me for d in devices)
+    except Exception:
+        return True
+
+
+def _sharding_spans_processes(sharding):
+    try:
+        devices = sharding.device_set
+    except Exception:
+        return jax.process_count() > 1
+    return _devices_span_processes(devices)
+
+
 def _put_replicated(x, sharding):
     """Place parameter/optimizer data with a (possibly multi-host) sharding.
-    Multi-process: broadcast process 0's value first, so every worker starts
-    from identical parameters regardless of local RNG state — the analog of
-    the reference's kvstore.init broadcast from worker 0
-    (ref: src/kvstore/kvstore_dist.h InitImpl)."""
-    if jax.process_count() > 1:
+    Process-SPANNING sharding: broadcast process 0's value first, so every
+    worker starts from identical parameters regardless of local RNG state —
+    the analog of the reference's kvstore.init broadcast from worker 0
+    (ref: src/kvstore/kvstore_dist.h InitImpl). A process-LOCAL sharding
+    in a multi-process world gets NO broadcast: its step never crosses
+    processes (independent replicas — e.g. an elastic survivor beside a
+    dead world, or drill workers), so identical init is the caller's
+    choice (seed identically, or sync via a dist kvstore), and the
+    broadcast collective is exactly what a dead peer would wedge."""
+    if _sharding_spans_processes(sharding):
         from jax.experimental import multihost_utils
         x = multihost_utils.broadcast_one_to_all(onp.asarray(x))
         x = onp.asarray(x)
@@ -70,7 +97,7 @@ def _put_batch(x, sharding):
     global batch. Multi-process: each process holds its OWN shard (the
     reference's per-worker data partition, tools/launch.py semantics), and
     the global batch is their concatenation over the dp axis."""
-    if jax.process_count() > 1:
+    if _sharding_spans_processes(sharding):
         return jax.make_array_from_process_local_data(
             sharding, onp.asarray(x))
     return jax.device_put(x, sharding)
@@ -294,8 +321,12 @@ class ShardedTrainStep:
         # ZeRO-1: default-on when a >1-device dp axis exists (the fp32
         # masters + Adam moments then live 1/dp per device). ZeRO-3
         # additionally shards the persistent params (gathered per layer
-        # on use inside the step).
+        # on use inside the step). The REQUESTED stage is kept so an
+        # elastic reset_mesh() re-derives the effective stage at the
+        # survivor world's dp degree.
+        self._requested_stage = stage
         self.zero_stage = stage if dp_size > 1 else 0
+        self._spans_processes = self._mesh_spans_processes()
         self.zero = self.zero_stage > 0
         self._dp_size = dp_size
         self._params = None       # list[(name, Parameter)]
@@ -311,6 +342,17 @@ class ShardedTrainStep:
         self._guard = guard
         if guard is not None:
             guard.add_post_restore_hook(self._replace_params_on_mesh)
+
+    def _mesh_spans_processes(self):
+        """Does this step's mesh include other processes' devices? Then
+        every step is a cross-process collective — one that a lost peer
+        wedges forever, which is why dispatch refuses to enter it once
+        the membership layer has declared a loss."""
+        try:
+            devices = list(self.mesh.devices.flat)
+        except Exception:
+            return jax.process_count() > 1
+        return _devices_span_processes(devices)
 
     # ------------------------------------------------------------------
     def _collect(self):
@@ -679,6 +721,14 @@ class ShardedTrainStep:
             # after the restore, so nothing here is stale)
             self._guard.pre_step()
         fault = _faults.fire('step.dispatch')
+        if self._spans_processes:
+            # a process-spanning step IS a collective: once the
+            # membership side channel has declared a peer lost, entering
+            # it would wedge this process forever — fail fast instead
+            # (ElasticController.pre_step turns the same signal into
+            # commit + re-form before dispatch ever gets here)
+            from ..resilience.elastic import raise_if_peer_lost
+            raise_if_peer_lost()
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
@@ -798,6 +848,48 @@ class ShardedTrainStep:
         loss_nd = NDArray(_local_value(loss))
         _flight.record_step(self._step_count, loss=loss_nd)
         return loss_nd
+
+    def reset_mesh(self, mesh=None):
+        """Adopt a NEW mesh (the elastic re-form path: the survivor
+        world's device set after a peer loss, or any deliberate
+        resize). Drops the compiled program, shardings and ZeRO layout
+        — all rebuilt at the new dp degree on the next ``__call__`` —
+        while carrying the training state across:
+
+        - parameters gather to host (when addressable) and re-place
+          with the new shardings at the next step;
+        - optimizer state + fp32 masters ride the layout-independent
+          ``get_states_bytes`` payload (the same contract checkpoints
+          use), so dp=N ZeRO shards re-scatter as dp=M — or fully
+          replicated — without precision loss;
+        - when the old world's arrays are no longer addressable (their
+          processes are gone), state is simply dropped: the caller
+          restores the committed checkpoint right after, which is the
+          elastic contract's source of truth anyway.
+        """
+        states = None
+        if self._compiled is not None:
+            try:
+                states = self.get_states_bytes()
+            except Exception:
+                states = None   # unaddressable shards: restore supplies
+            for _n, p in self._trainable + self._frozen:
+                d = p.data()._data
+                if getattr(d, 'is_fully_addressable', True):
+                    p.data()._data = jnp.asarray(onp.asarray(d))
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self._dp_size = dict(self.mesh.shape).get(self.dp_axis, 1)
+        self.zero_stage = self._requested_stage if self._dp_size > 1 else 0
+        self.zero = self.zero_stage > 0
+        self._spans_processes = self._mesh_spans_processes()
+        self._compiled = None
+        self._cost_args = None
+        self._master = None
+        self._opt_state = None
+        self._pending_states = None
+        if states is not None:
+            self.set_states_bytes(states)
+        return self
 
     def _replace_params_on_mesh(self):
         """After an external restore wrote host arrays into the

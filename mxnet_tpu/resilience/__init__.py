@@ -5,8 +5,10 @@ durability half, see ``mxnet_tpu.checkpoint``): deterministic fault
 injection so every recovery path is exercised by real failures in CI
 (``faults``), an on-device non-finite guard with skip-step and
 auto-rollback policies (``guard``), a heartbeat watchdog that dumps
-all-thread stacks when a step wedges (``watchdog``), and the shared
-bounded retry helper (``retry``).
+all-thread stacks when a step wedges (``watchdog``), the shared
+bounded retry helper (``retry``), and the elastic commit -> re-form ->
+resume controller for multi-host peer loss / preemption (``elastic``,
+with the membership side channel in ``parallel.dist``).
 
 Arm faults with ``MXTPU_FAULT=site:kind[:prob[:seed[:first-last]]]``
 (see ``faults.sites()`` for the registered sites).
@@ -14,13 +16,16 @@ Arm faults with ``MXTPU_FAULT=site:kind[:prob[:seed[:first-last]]]``
 from __future__ import annotations
 
 from . import faults
+from .elastic import (ElasticController, PeerLossError, Preempted,
+                      stall_verdict)
 from .faults import InjectedFault
 from .guard import NonFiniteGuard
 from .retry import retry_call
 from .watchdog import StepWatchdog, format_all_stacks
 
 __all__ = ['faults', 'InjectedFault', 'NonFiniteGuard', 'retry_call',
-           'StepWatchdog', 'format_all_stacks']
+           'StepWatchdog', 'format_all_stacks', 'ElasticController',
+           'PeerLossError', 'Preempted', 'stall_verdict']
 
 # arm any sites named by the environment at import (the config var is
 # read through the declared registry; an empty/unset var arms nothing)

@@ -1,0 +1,334 @@
+"""Elastic-training drill: kill a worker, watch the survivor re-form.
+
+The CI-testable half of the elastic story (ISSUE 8 / ROADMAP item 4):
+``run_drill`` spawns two real worker processes under ``JAX_PLATFORMS=cpu``
+(each with its own jax.distributed rank, membership heartbeat sender and
+CheckpointManager), SIGKILLs one mid-run, and asserts the survivor
+
+1. detects the loss on the membership side channel within the peer
+   deadline,
+2. commits a checkpoint at its last completed step,
+3. tears down jax.distributed (bounded — the runtime's shutdown barrier
+   would wait for the corpse) and re-forms its mesh at world size 1,
+4. resumes from the committed step with a trajectory **bit-identical**
+   to a clean single-process run restored from the same checkpoint
+   (verified by a third reference process).
+
+It returns the measured MTTR phases (detect / commit / teardown /
+restore / first-resumed-step), which ``__graft_entry__.dryrun_multichip``
+records each MULTICHIP round and ``tests/test_elastic.py`` asserts in
+CI. Workers train on process-LOCAL meshes (this jaxlib's CPU backend
+has no cross-process collectives — the same capability gap
+tests/test_interop_tools.py skips on); the membership, commit, teardown
+and re-form machinery is exactly the multi-host path.
+
+Run a worker by hand::
+
+    python -m mxnet_tpu.resilience.drill --worker --workdir /tmp/d ...
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time as _time
+
+__all__ = ['run_drill']
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+def _data_for(step, batch=16, dim=8):
+    """Deterministic per-step batch: the same step index produces the
+    same bytes in every process — the precondition for bit-identical
+    resume parity."""
+    import numpy as onp
+    rng = onp.random.RandomState(10_000 + int(step))
+    x = rng.randn(batch, dim).astype(onp.float32)
+    y = (x.sum(axis=1) > 0).astype(onp.float32)
+    return x, y
+
+
+def _build(workdir, rank, mesh):
+    """Model + compiled step + checkpoint manager for one worker.
+    Explicit prefixes: every process (workers, the reference run) must
+    produce identical parameter names for the states payload to apply."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu import checkpoint as _checkpoint
+    from mxnet_tpu.parallel import ShardedTrainStep
+
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential(prefix='drill_')
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation='relu', prefix='fc1_'),
+                gluon.nn.Dense(2, prefix='fc2_'))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss_fn, 'adam',
+                            {'learning_rate': 0.05}, mesh=mesh)
+    mgr = _checkpoint.CheckpointManager(
+        os.path.join(workdir, f'ckpt-rank{rank}'),
+        params=net, trainer=step, async_save=False)
+    return net, step, mgr
+
+
+def _run_step(step, i):
+    from mxnet_tpu import nd
+    x, y = _data_for(i)
+    return float(step(nd.array(x), nd.array(y)).asnumpy())
+
+
+def _worker(args):
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1)   # stacks on demand in CI
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    from mxnet_tpu.parallel import dist, make_mesh
+    from mxnet_tpu.resilience import ElasticController
+
+    rank = int(os.environ.get('MXNET_TPU_PROC_ID', '0'))
+    progress = os.path.join(args.workdir, f'progress-rank{rank}.txt')
+    dist.init()
+    ms = dist.start_membership(port=args.port,
+                               heartbeat_seconds=args.heartbeat,
+                               deadline_seconds=args.deadline)
+    mesh = make_mesh(devices=jax.local_devices())
+    net, step, mgr = _build(args.workdir, rank, mesh)
+    ctl = ElasticController(manager=mgr, membership=ms, step=step)
+    ctl.start_monitor()
+
+    marks = {'rank': rank, 'start_wall': _time.time()}
+    losses, post = {}, {}
+    i = 0
+    while i < args.steps:
+        resumed = ctl.pre_step()
+        if resumed is not None:
+            marks['reform'] = ctl.last_reform
+            marks['reform_done_wall'] = _time.time()
+            marks['resumed_step'] = resumed
+            i = int(resumed)
+            continue
+        t0 = _time.perf_counter()
+        loss = _run_step(step, i + 1)
+        dt = _time.perf_counter() - t0
+        i += 1
+        ctl.beat(i)
+        losses[i] = float(loss).hex()
+        if 'reform' in marks:
+            post[i] = float(loss).hex()
+            marks.setdefault('first_resumed_step_seconds', dt)
+            marks.setdefault('first_resumed_step_wall', _time.time())
+        with open(progress, 'w') as f:
+            f.write(str(i))
+        if args.step_sleep:
+            _time.sleep(args.step_sleep)
+    ctl.stop_monitor()
+    mgr.close()
+    out = {'marks': marks, 'losses': losses, 'post': post,
+           'world': ms.world_size(), 'reforms': ctl.reforms,
+           'peer_losses': ctl.peer_losses}
+    with open(os.path.join(args.workdir, f'result-rank{rank}.json'),
+              'w') as f:
+        json.dump(out, f, indent=1)
+    ms.stop()
+
+
+def _reference(args):
+    """Clean single-process resume: restore the survivor's committed
+    checkpoint and train the remaining steps — the trajectory the
+    survivor's post-re-form segment must match bit-for-bit."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    from mxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh(devices=jax.local_devices())
+    net, step, mgr = _build(args.workdir, args.ref_rank, mesh)
+    start = mgr.restore_latest()
+    losses = {}
+    for i in range(int(start), args.steps):
+        losses[i + 1] = float(_run_step(step, i + 1)).hex()
+    with open(os.path.join(args.workdir, 'result-reference.json'),
+              'w') as f:
+        json.dump({'restored_step': start, 'losses': losses}, f, indent=1)
+    mgr.close()
+
+
+def _wait_progress(path, target, timeout):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                if int(f.read().strip() or 0) >= target:
+                    return True
+        except (OSError, ValueError):
+            pass
+        _time.sleep(0.05)
+    return False
+
+
+def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
+              step_sleep=0.35, timeout=180.0, victim_rank=1):
+    """Run the two-worker SIGKILL drill. Returns a dict with the
+    survivor's MTTR phase breakdown and the bit-parity verdict (raises
+    AssertionError on any broken guarantee)."""
+    os.makedirs(workdir, exist_ok=True)
+    jax_port, side_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update({
+        'PYTHONPATH': os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))] +
+            ([env['PYTHONPATH']] if env.get('PYTHONPATH') else [])),
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+        'MXNET_TPU_COORDINATOR': f'localhost:{jax_port}',
+        'MXNET_TPU_NUM_PROCS': '2',
+        'MXTPU_ELASTIC': '1',
+        # the membership knobs ride the env so dist.init()'s automatic
+        # start_membership and the worker's explicit call agree
+        'MXTPU_ELASTIC_PORT': str(side_port),
+        'MXTPU_HEARTBEAT_SECONDS': str(heartbeat),
+        'MXTPU_PEER_DEADLINE_SECONDS': str(deadline),
+    })
+    base = [sys.executable, '-m', 'mxnet_tpu.resilience.drill',
+            '--workdir', workdir, '--steps', str(steps),
+            '--port', str(side_port), '--heartbeat', str(heartbeat),
+            '--deadline', str(deadline), '--step-sleep', str(step_sleep)]
+    procs, logs = [], []
+    for r in range(2):
+        e = dict(env)
+        e['MXNET_TPU_PROC_ID'] = str(r)
+        log = open(os.path.join(workdir, f'worker-rank{r}.log'), 'wb')
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            base + ['--worker'], env=e, stdout=log,
+            stderr=subprocess.STDOUT))
+    survivor_rank = 1 - victim_rank
+    victim, survivor = procs[victim_rank], procs[survivor_rank]
+
+    def _fail(msg):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        errs = []
+        for i, log in enumerate(logs):
+            log.flush()
+            try:
+                with open(log.name, 'rb') as f:
+                    errs.append(f"-- rank {i} log --\n" +
+                                f.read().decode(errors='replace')[-3000:])
+            except OSError:
+                pass
+        raise AssertionError(msg + '\n' + '\n'.join(errs))
+
+    # let both ranks make real progress before the kill
+    for r in range(2):
+        if not _wait_progress(
+                os.path.join(workdir, f'progress-rank{r}.txt'),
+                kill_at, timeout / 2):
+            _fail(f"drill: rank {r} never reached step {kill_at}")
+    victim.kill()                       # SIGKILL: no goodbye, no flush
+    kill_wall = _time.time()
+    victim.wait()
+    try:
+        survivor.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _fail("drill: survivor did not exit (re-form wedged?)")
+    if survivor.returncode != 0:
+        _fail(f"drill: survivor exited rc={survivor.returncode}")
+    for log in logs:
+        log.close()
+    with open(os.path.join(workdir,
+                           f'result-rank{survivor_rank}.json')) as f:
+        res = json.load(f)
+    marks = res['marks']
+    assert res['reforms'] == 1 and res['peer_losses'] == 1, res
+    assert marks.get('reform', {}).get('world') == 1, marks
+    assert res['post'], "survivor recorded no post-re-form steps"
+
+    # reference: clean restore of the SAME committed checkpoint
+    ref_cmd = base + ['--reference', '--ref-rank', str(survivor_rank)]
+    e = dict(env)
+    e['MXNET_TPU_NUM_PROCS'] = '1'
+    e['MXNET_TPU_PROC_ID'] = '0'
+    r = subprocess.run(ref_cmd, env=e, capture_output=True, timeout=timeout)
+    assert r.returncode == 0, r.stderr.decode(errors='replace')[-3000:]
+    with open(os.path.join(workdir, 'result-reference.json')) as f:
+        ref = json.load(f)
+    assert ref['restored_step'] == marks['resumed_step'], (ref, marks)
+    assert res['post'] == ref['losses'], (
+        "post-re-form trajectory diverges from a clean restore of the "
+        "same checkpoint", res['post'], ref['losses'])
+
+    reform = marks['reform']
+    detect_seconds = round(
+        marks['reform_done_wall'] - reform['reform_seconds'] - kill_wall, 3)
+    mttr = {
+        'detect_seconds': detect_seconds,
+        'commit_seconds': reform['commit_seconds'],
+        'teardown_seconds': reform['teardown_seconds'],
+        'restore_seconds': reform['restore_seconds'],
+        'reform_seconds': reform['reform_seconds'],
+        'first_resumed_step_seconds': round(
+            marks.get('first_resumed_step_seconds', 0.0), 3),
+        'total_seconds': round(
+            marks.get('first_resumed_step_wall',
+                      marks['reform_done_wall']) - kill_wall, 3),
+    }
+    assert detect_seconds <= deadline + max(
+        4 * heartbeat, 1.0) + step_sleep + 1.0, (
+        f"peer loss detected {detect_seconds}s after the kill — past "
+        f"the {deadline}s deadline budget", mttr)
+    return {
+        'ok': True,
+        'committed_step': marks['resumed_step'],
+        'post_steps': len(res['post']),
+        'bit_identical': True,
+        'deadline_seconds': deadline,
+        'mttr': mttr,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--worker', action='store_true')
+    ap.add_argument('--reference', action='store_true')
+    ap.add_argument('--workdir', required=True)
+    ap.add_argument('--steps', type=int, default=10)
+    ap.add_argument('--port', type=int, default=0)
+    ap.add_argument('--heartbeat', type=float, default=0.2)
+    ap.add_argument('--deadline', type=float, default=1.2)
+    ap.add_argument('--step-sleep', type=float, default=0.35)
+    ap.add_argument('--ref-rank', type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.worker:
+        _worker(args)
+    elif args.reference:
+        _reference(args)
+    else:
+        print(json.dumps(run_drill(args.workdir, steps=args.steps,
+                                   heartbeat=args.heartbeat,
+                                   deadline=args.deadline,
+                                   step_sleep=args.step_sleep), indent=1))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

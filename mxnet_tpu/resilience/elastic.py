@@ -1,0 +1,475 @@
+"""Elastic multi-host training: peer loss -> commit -> re-form -> resume.
+
+The reference survives worker churn at the ps-lite tracker level
+(dist_sync workers re-register; PAPER.md layer 6); the GSPMD replacement
+has no such story — one preempted host wedges every peer inside a
+collective until the job is killed. This module is the recovery seam
+between three existing substrates:
+
+- the **membership side channel** (``parallel.dist.Membership``): rank-0
+  coordinator + per-process heartbeat senders on a TCP socket, so peer
+  loss is observable while the collective fabric is wedged;
+- **layout-independent checkpoints** (``checkpoint.CheckpointManager``):
+  every states payload is host-gathered fp32, so ANY survivor set can
+  restore what any world size committed;
+- the **resilience ladder** (guard -> rollback -> retry): the same
+  commit/restore/re-place plumbing, pointed at a world-size change
+  instead of a NaN burst.
+
+``ElasticController`` supervises a training loop::
+
+    ms  = dist.start_membership()                  # or MXTPU_ELASTIC=1
+    ctl = resilience.ElasticController(manager=mgr, step=sharded_step)
+    ctl.install()                                  # SIGTERM -> preempt
+    i = start
+    while i < total:
+        resumed = ctl.pre_step()                   # peer lost? commit+reform
+        if resumed is not None:
+            i = resumed                            # back to the commit
+            continue
+        loss = sharded_step(data, label)
+        i += 1
+        ctl.beat(i)                            # feeds the heartbeats
+
+On **SIGTERM** (preemption notice): commit a final checkpoint, say
+goodbye on the side channel (peers see a departure, not a failure) and
+raise ``Preempted`` — the loop exits resumable. On **peer loss** (a
+heartbeat age past ``MXTPU_PEER_DEADLINE_SECONDS``): commit at the last
+completed step, tear down ``jax.distributed`` (bounded — the runtime's
+own shutdown barrier would wait for the dead peer), re-form the mesh at
+the survivor world size, re-place params/optimizer state through the
+attached step/trainer hooks, restore the committed checkpoint and
+return the resumed step. ``gluon.Trainer`` loops run unmodified via
+``trainer.attach_elastic(ctl)``.
+"""
+from __future__ import annotations
+
+import logging
+import signal as _signal
+import threading
+import time as _time
+
+from ..base import MXNetError, telem_flags as _telem
+
+__all__ = ['Preempted', 'PeerLossError', 'ElasticController',
+           'stall_verdict', 'raise_if_peer_lost']
+
+_log = logging.getLogger('mxnet_tpu.resilience')
+
+
+class Preempted(MXNetError):
+    """Raised by ``ElasticController.pre_step()`` after a SIGTERM: the
+    final checkpoint is committed — the process should exit and be
+    restarted (or not) by its scheduler."""
+
+    def __init__(self, step):
+        super().__init__(
+            f"preemption notice received: final checkpoint committed — "
+            f"resumable from step {step}")
+        self.step = step
+
+
+class PeerLossError(MXNetError):
+    """A peer went silent past the deadline and the caller cannot
+    re-form (no manager/controller) — raised instead of entering a
+    collective that would wedge forever."""
+
+    def __init__(self, lost, ages=None):
+        ages = ages or {}
+        detail = ', '.join(
+            f"rank {r} (last heartbeat {ages.get(r, float('nan')):.1f}s "
+            f"ago)" for r in lost)
+        super().__init__(
+            f"peer loss detected on the membership side channel: "
+            f"{detail or lost} — refusing to enter a collective that "
+            f"would wedge; commit + re-form via "
+            f"resilience.ElasticController, or restart the job")
+        self.lost = list(lost)
+
+
+def raise_if_peer_lost():
+    """Shared guard for collective entry points (ShardedTrainStep
+    dispatch, dist kvstore push): once the membership layer has declared
+    a peer lost, entering a cross-process collective would wedge forever
+    — raise the recoverable ``PeerLossError`` instead. No-op without a
+    membership layer."""
+    from ..parallel import dist as _dist
+    ms = _dist.membership()
+    if ms is None:
+        return
+    lost = ms.lost_peers()
+    if lost:
+        raise PeerLossError(lost, ms.peer_ages())
+
+
+def stall_verdict(membership=None):
+    """Classify a stall: ``peer_loss`` (some peer's heartbeat age is
+    past the deadline — the wedge is a REMOTE preemption) vs
+    ``local_stall`` (every peer is beating — the wedge is local code).
+    Returns ``{'verdict', 'peer_ages', 'lost', 'deadline_seconds'}`` or
+    None when no membership layer is running (single-process jobs have
+    no peers to blame)."""
+    if membership is None:
+        from ..parallel import dist as _dist
+        membership = _dist.membership()
+    if membership is None:
+        return None
+    try:
+        lost = membership.lost_peers()
+        ages = membership.peer_ages()
+    except Exception:
+        return None
+    return {
+        'verdict': 'peer_loss_suspected' if lost else 'local_stall',
+        'peer_ages': {int(r): round(float(a), 3)
+                      for r, a in ages.items()},
+        'lost': [int(r) for r in lost],
+        'deadline_seconds': membership.deadline_seconds,
+    }
+
+
+class ElasticController:
+    """Supervises commit -> re-form -> resume for one training loop.
+
+    Parameters
+    ----------
+    manager : checkpoint.CheckpointManager
+        Commits the final checkpoint and restores it post-re-form.
+    membership : parallel.dist.Membership, optional
+        Defaults to the process-global one (``dist.membership()``),
+        resolved lazily so construction order does not matter.
+    step / trainer : optional
+        A ``ShardedTrainStep`` (re-formed via ``reset_mesh``) and/or a
+        ``gluon.Trainer`` (re-formed via ``_on_reform``); attach more
+        with ``attach_step`` / ``attach_trainer``.
+    mesh_fn : callable(new_world, new_rank) -> Mesh, optional
+        Builds the survivor mesh. Default: every LOCAL device on one
+        ``dp`` axis (always valid for the survivors' processes; a
+        process-spanning re-form needs ``reinit_fn`` too).
+    reinit_fn : callable(new_world, new_rank) -> None, optional
+        Re-initializes ``jax.distributed`` for a >1-process survivor
+        world (deployment-specific: someone must pick the new
+        coordinator address). Without it a multi-process re-form keeps
+        process-local meshes and logs what it skipped.
+    coordinator_host_fn : callable(rank) -> host, optional
+        Resolves a rank's host for membership-coordinator failover:
+        when rank 0 dies, the lowest survivor promotes itself and the
+        others retarget their heartbeats at it. Default keeps the
+        current host (correct when survivors share one, e.g. the CPU
+        drill; multi-host deployments must supply the resolver).
+    """
+
+    def __init__(self, manager, membership=None, step=None, trainer=None,
+                 mesh_fn=None, reinit_fn=None, on_reform=None,
+                 coordinator_host_fn=None):
+        self.manager = manager
+        self._membership = membership
+        self._steps = [step] if step is not None else []
+        self._trainers = [trainer] if trainer is not None else []
+        self.mesh_fn = mesh_fn
+        self.reinit_fn = reinit_fn
+        self.coordinator_host_fn = coordinator_host_fn
+        self._on_reform_hooks = [on_reform] if on_reform else []
+        self.preempt_requested = False
+        self.last_step = None
+        self.peer_losses = 0
+        self.reforms = 0
+        self.last_reform = None       # phase timings of the newest re-form
+        self._old_handlers = {}
+        self._monitor = None
+        self._monitor_stop = threading.Event()
+        self._suspected = set()
+
+    # -- wiring ------------------------------------------------------------
+
+    @property
+    def membership(self):
+        if self._membership is None:
+            from ..parallel import dist as _dist
+            self._membership = _dist.membership()
+        return self._membership
+
+    def attach_step(self, step):
+        """Attach a ShardedTrainStep: re-formed via ``reset_mesh``."""
+        self._steps.append(step)
+        return self
+
+    def attach_trainer(self, trainer):
+        """Attach a gluon Trainer: re-formed via ``_on_reform`` (and its
+        ``step()`` consults this controller when bound the other way
+        round with ``trainer.attach_elastic``)."""
+        self._trainers.append(trainer)
+        return self
+
+    def add_reform_hook(self, fn):
+        """Run ``fn(mesh)`` after every re-form (post-restore)."""
+        self._on_reform_hooks.append(fn)
+
+    # -- preemption --------------------------------------------------------
+
+    def install(self, signals=(_signal.SIGTERM,)):
+        """SIGTERM -> ``preempt_requested`` (the commit happens at the
+        next ``pre_step``, on the training thread, where device state is
+        consistent). Chains any previous handler — including the
+        CheckpointManager preemption hook, so the grace-window save
+        still runs even if the loop never reaches another step."""
+        for sig in signals:
+            try:
+                old = _signal.signal(sig, self._on_signal)
+            except ValueError:
+                import warnings
+                warnings.warn(
+                    "elastic preemption hook not installed: signal "
+                    "handlers can only be set from the main thread",
+                    RuntimeWarning)
+                return self
+            self._old_handlers.setdefault(sig, old)
+        return self
+
+    def uninstall(self):
+        for sig, old in self._old_handlers.items():
+            _signal.signal(sig, old if old is not None else _signal.SIG_DFL)
+        self._old_handlers.clear()
+
+    def _on_signal(self, signum, frame):
+        # handler body stays lock-free: flight.note takes the recorder
+        # lock, and a signal landing while THIS thread holds it (e.g.
+        # inside record_step under MXTPU_TRACE) would self-deadlock —
+        # the note is emitted from pre_step instead
+        self.preempt_requested = True
+        self._preempt_signum = int(signum)
+        old = self._old_handlers.get(signum)
+        if callable(old):
+            old(signum, frame)
+
+    # -- monitor thread ----------------------------------------------------
+
+    def start_monitor(self, poll_seconds=None):
+        """Background collective-deadline monitor: polls the membership
+        and records a classified ``elastic.peer_loss_suspected`` flight
+        note + telemetry the moment a peer goes silent — even while the
+        training thread is wedged inside a collective (the re-form
+        itself still happens on the training thread at ``pre_step``,
+        where device state is consistent)."""
+        if self._monitor is not None and self._monitor.is_alive():
+            return self
+        ms = self.membership
+        poll = float(poll_seconds) if poll_seconds else max(
+            0.05, (ms.heartbeat_seconds if ms else 1.0))
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_run, args=(poll,), daemon=True,
+            name='mxtpu-elastic-monitor')
+        self._monitor.start()
+        return self
+
+    def stop_monitor(self):
+        self._monitor_stop.set()
+        t = self._monitor
+        if t is not None:
+            t.join(timeout=2.0)
+        self._monitor = None
+
+    def _monitor_run(self, poll):
+        from ..telemetry import flight as _flight
+        while not self._monitor_stop.wait(poll):
+            ms = self.membership
+            if ms is None:
+                continue
+            try:
+                lost = [r for r in ms.lost_peers()
+                        if r not in self._suspected]
+            except Exception:
+                continue
+            if not lost:
+                continue
+            self._suspected.update(lost)
+            v = stall_verdict(ms) or {}
+            _log.error(
+                "elastic monitor: peer(s) %s silent past the %.1fs "
+                "deadline (ages: %s) — will commit + re-form at the "
+                "next step boundary", lost, ms.deadline_seconds,
+                v.get('peer_ages'))
+            _flight.note('elastic.peer_loss_suspected', lost=lost,
+                         peer_ages=v.get('peer_ages'))
+
+    # -- per-step supervision ----------------------------------------------
+
+    def beat(self, step):
+        """The training loop completed ``step``. Cheap: remembers the
+        commit point and piggybacks it on the next heartbeat."""
+        self.last_step = int(step)
+        ms = self.membership
+        if ms is not None:
+            ms.current_step = int(step)
+
+    def pre_step(self):
+        """Call at the start of every training step (gluon ``Trainer``
+        does this automatically once ``attach_elastic`` is bound).
+
+        - Preemption requested: commit the final checkpoint, leave the
+          membership gracefully, raise ``Preempted``.
+        - Peer lost: commit, tear down, re-form at the survivor world
+          size, restore — returns the RESUMED step number (the loop
+          should continue from there).
+        - Otherwise: returns None, costing two lock-free reads.
+        """
+        if self.preempt_requested:
+            self._commit(final=True)
+            ms = self.membership
+            if ms is not None:
+                ms.leave()
+            from ..telemetry import flight as _flight
+            _flight.note('elastic.preempt_exit', step=self.last_step,
+                         signum=getattr(self, '_preempt_signum', None))
+            raise Preempted(self.last_step)
+        ms = self.membership
+        if ms is None:
+            return None
+        lost = ms.lost_peers()
+        if not lost:
+            return None
+        return self._reform(lost)
+
+    # -- the re-form path --------------------------------------------------
+
+    def _commit(self, final=False):
+        if self.manager is None:
+            return None
+        step = self.last_step
+        if step is None:
+            step = self.manager._current_step or 0
+        if self.manager.latest_step() == int(step):
+            self.manager.wait()       # already committed (cadence save)
+            return int(step)
+        self.manager.save_now(int(step))
+        if final:
+            _log.warning(
+                "elastic: final checkpoint committed at step %d", step)
+        return int(step)
+
+    def _reform(self, lost):
+        from ..telemetry import flight as _flight, trace as _trace
+        from ..parallel import dist as _dist
+        from ..parallel.mesh import make_mesh, set_default_mesh
+        import jax
+
+        ms = self.membership
+        ages = {}
+        try:
+            ages = ms.peer_ages()
+        except Exception:
+            pass
+        self.peer_losses += len(lost)
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.counter(
+                'mxnet_tpu_elastic_peer_losses_total').inc(len(lost))
+        _log.error(
+            "elastic: peer(s) %s lost (heartbeat ages %s > %.1fs "
+            "deadline) — committing, re-forming at the survivor world "
+            "size", lost, {r: ages.get(r) for r in lost},
+            ms.deadline_seconds)
+        _flight.note('elastic.peer_loss', lost=list(lost),
+                     peer_ages={int(r): ages.get(r) for r in lost})
+        t0 = _time.perf_counter()
+        with _trace.span('elastic.reform', lost=len(lost)):
+            # 1. commit: the survivors' restart point. States payloads
+            # are host-gathered (PR-4/PR-7 layout independence), so this
+            # world's layout does not constrain who restores it.
+            committed = self._commit()
+            t_commit = _time.perf_counter()
+            # 2. tear down the old world (bounded: the runtime's shutdown
+            # barrier waits for the dead peer). Survivors are computed
+            # BEFORE remove_peers: once the lost set is retired, a stale
+            # coordinator-produced view could no longer exclude it.
+            survivors = sorted(
+                (set(ms.alive()) | {ms.rank}) - set(lost))
+            _dist.shutdown()
+            ms.remove_peers(lost)
+            new_world = len(survivors)
+            new_rank = survivors.index(ms.rank)
+            if 0 in lost:
+                if new_rank == 0:
+                    # lowest survivor inherits the side channel
+                    ms.become_coordinator()
+                else:
+                    ms.retarget(host=self.coordinator_host_fn(survivors[0])
+                                if self.coordinator_host_fn else None)
+            # 3. re-form at the new world size. One FIXED tag for every
+            # re-form: survivors whose views diverged (losses declared a
+            # heartbeat apart) must still rendezvous at the same tag —
+            # the barrier's generation counter keeps successive re-forms
+            # distinct, and its completion re-reads the live alive set,
+            # so a straggler that dies mid-rendezvous is not waited for.
+            if new_world > 1:
+                ms.barrier('reform')
+                if self.reinit_fn is not None:
+                    self.reinit_fn(new_world, new_rank)
+                else:
+                    _log.warning(
+                        "elastic: %d survivors but no reinit_fn — "
+                        "keeping process-local meshes (cross-process "
+                        "collectives need a new jax.distributed "
+                        "coordinator; pass reinit_fn to re-span)",
+                        new_world)
+            if self.mesh_fn is not None:
+                mesh = self.mesh_fn(new_world, new_rank)
+            else:
+                mesh = make_mesh(devices=jax.local_devices())
+            set_default_mesh(mesh)
+            t_teardown = _time.perf_counter()
+            # 4. re-place + restore: steps drop their compiled programs
+            # and shardings (rebuilt at the new world on next call),
+            # then the committed checkpoint restores params + optimizer
+            # state + RNG through the layout-independent payloads.
+            for st in self._steps:
+                st.reset_mesh(mesh)
+            for tr in self._trainers:
+                tr._on_reform(mesh)
+            resumed = self.manager.restore_latest() \
+                if self.manager is not None else committed
+            for fn in self._on_reform_hooks:
+                fn(mesh)
+        dt = _time.perf_counter() - t0
+        self.reforms += 1
+        self._suspected -= set(lost)
+        self.last_reform = {
+            'lost': list(lost),
+            'world': new_world,
+            'rank': new_rank,
+            'resumed_step': resumed,
+            'commit_seconds': round(t_commit - t0, 4),
+            'teardown_seconds': round(t_teardown - t_commit, 4),
+            'restore_seconds': round(
+                dt - (t_teardown - t0), 4),
+            'reform_seconds': round(dt, 4),
+        }
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_elastic_reforms_total')
+            _telemetry.set_gauge('mxnet_tpu_elastic_last_world_size',
+                                 new_world)
+            _telemetry.observe('mxnet_tpu_elastic_reform_seconds', dt)
+        _log.warning(
+            "elastic: re-formed at world size %d (rank %d) in %.3fs "
+            "(commit %.3fs, teardown %.3fs, restore %.3fs) — resuming "
+            "from committed step %s", new_world, new_rank, dt,
+            self.last_reform['commit_seconds'],
+            self.last_reform['teardown_seconds'],
+            self.last_reform['restore_seconds'], resumed)
+        _flight.note('elastic.reform', **self.last_reform)
+        return resumed
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self.stop_monitor()
+        self.uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

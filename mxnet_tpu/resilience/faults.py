@@ -85,6 +85,14 @@ _SITES = {
                          ('raise', 'hang', 'corrupt')),
     'collective.all_reduce': ('kvstore gradient reduction across device '
                               'copies', ('raise', 'hang')),
+    'dist.heartbeat': ('elastic membership heartbeat send (parallel.dist.'
+                       'Membership; raise drops the beat — enough '
+                       'consecutive drops and the coordinator declares '
+                       'this worker lost; hang delays the beat past the '
+                       'peer deadline)', ('raise', 'hang')),
+    'dist.barrier': ('membership barrier entry (dist.barrier / kvstore '
+                     'barrier on dist stores) — the rendezvous every '
+                     'mesh re-form crosses', ('raise', 'hang')),
 }
 
 _lock = threading.RLock()

@@ -61,7 +61,8 @@ class StepWatchdog:
     """
 
     def __init__(self, deadline_seconds=None, poll_seconds=None,
-                 manager=None, save_on_stall=False, on_stall=None):
+                 manager=None, save_on_stall=False, on_stall=None,
+                 membership=None):
         if deadline_seconds is None:
             from .. import config as _config
             deadline_seconds = _config.get('MXTPU_WATCHDOG_SECONDS')
@@ -73,6 +74,10 @@ class StepWatchdog:
         self.manager = manager
         self.save_on_stall = bool(save_on_stall)
         self.on_stall = on_stall
+        # elastic membership for the stall verdict: explicit, or the
+        # process-global one (resolved at dump time, so construction
+        # order vs dist.init() does not matter)
+        self.membership = membership
         self.stalls = 0
         self.last_step = None
         self._beat_time = None
@@ -139,15 +144,25 @@ class StepWatchdog:
         if _telem['on']:
             from .. import telemetry as _telemetry
             _telemetry.inc('mxnet_tpu_resilience_watchdog_stalls_total')
-        report = self._format_report(age, step)
+        # one verdict per stall, shared by the report and the flight
+        # note (computing it twice could disagree mid-transition)
+        verdict = self._stall_verdict()
+        report = self._format_report(age, step, verdict)
         # flight recorder: note the stall and dump the black box (span
         # rings are flushed — open spans get synthetic closes — so the
         # hang leaves a loadable timeline naming the wedged scope, not
         # just thread stacks). Must never wedge the watchdog itself.
         try:
             from ..telemetry import flight as _flight
-            _flight.note('watchdog.stall', age_seconds=round(age, 1),
-                         step=step)
+            note = dict(age_seconds=round(age, 1), step=step)
+            if verdict is not None:
+                # the classified verdict + per-peer heartbeat ages ride
+                # in the dump, so a post-mortem never misattributes a
+                # remote preemption to local code (or vice versa)
+                note.update(verdict=verdict['verdict'],
+                            peer_ages=verdict['peer_ages'],
+                            lost_peers=verdict['lost'])
+            _flight.note('watchdog.stall', **note)
             path = _flight.dump(reason='watchdog_stall')
             if path:
                 report += f"\nflight recorder dumped to {path}"
@@ -182,14 +197,41 @@ class StepWatchdog:
         except Exception:
             _log.exception("watchdog: emergency save_now() failed")
 
-    def _format_report(self, age, step):
+    def _stall_verdict(self):
+        """Classified stall verdict from the elastic membership layer
+        (None when no membership is running). Never raises — the
+        watchdog must keep reporting whatever else is broken."""
+        try:
+            from .elastic import stall_verdict
+            return stall_verdict(self.membership)
+        except Exception:
+            return None
+
+    def _format_report(self, age, step, verdict=None):
         lines = [
             f"watchdog: no training-step heartbeat for {age:.1f}s "
             f"(deadline {self.deadline_seconds:.1f}s, last step "
             f"{step if step is not None else 'unknown'}) — the step is "
             f"stalled. All-thread stacks follow.",
-            format_all_stacks(),
         ]
+        if verdict is None:
+            verdict = self._stall_verdict()
+        if verdict is not None:
+            if verdict['lost']:
+                lines.insert(1, (
+                    f"verdict: PEER LOSS SUSPECTED — peer(s) "
+                    f"{verdict['lost']} silent past the "
+                    f"{verdict['deadline_seconds']:.1f}s membership "
+                    f"deadline (last-heartbeat ages per peer: "
+                    f"{verdict['peer_ages']}); the wedge is most likely "
+                    f"a remote preemption, not local code."))
+            else:
+                lines.insert(1, (
+                    f"verdict: LOCAL STALL — every peer is still "
+                    f"heartbeating (last-heartbeat ages per peer: "
+                    f"{verdict['peer_ages']}); the wedge is in THIS "
+                    f"process."))
+        lines.append(format_all_stacks())
         try:
             from .. import telemetry as _telemetry
             snap = _telemetry.report()

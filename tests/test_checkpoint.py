@@ -98,7 +98,10 @@ def test_restore_apply_false_returns_payload(tmp_path):
     mgr.save(3, metadata={'note': 'hello'}, block=True)
     ck = mgr.restore_latest(apply=False)
     assert ck.step == 3
-    assert ck.metadata == {'note': 'hello'}
+    assert ck.metadata['note'] == 'hello'
+    # every step also records the world it was committed under (the
+    # elastic-resume audit trail; single process here)
+    assert ck.metadata['world']['processes'] == 1
     onp.testing.assert_array_equal(ck.params['w'],
                                    arrs['w'].asnumpy())
     mgr.close()

@@ -1,0 +1,562 @@
+"""Elastic multi-host training (ISSUE 8): membership side channel,
+peer-loss detection, commit -> re-form -> resume, and the two-worker
+SIGKILL drill (SURVEY §4 pattern: distributed behavior as multiple local
+processes)."""
+import os
+import signal
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon, nd, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import dist, make_mesh, ShardedTrainStep
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.resilience.drill import _free_port, run_drill
+from mxnet_tpu.resilience.elastic import (ElasticController, Preempted,
+                                          PeerLossError, stall_verdict)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Membership/fault globals must never leak between tests."""
+    yield
+    dist.stop_membership()
+    faults.disarm()
+
+
+def _pair(port, heartbeat=0.05, deadline=0.5):
+    m0 = dist.Membership(0, 2, port=port, heartbeat_seconds=heartbeat,
+                         deadline_seconds=deadline)
+    m1 = dist.Membership(1, 2, port=port, heartbeat_seconds=heartbeat,
+                         deadline_seconds=deadline)
+    return m0, m1
+
+
+def _wait_until(fn, timeout=5.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(every)
+    return False
+
+
+class StubMembership:
+    """Scripted membership for controller/watchdog tests."""
+    rank = 0
+    deadline_seconds = 1.0
+    heartbeat_seconds = 0.05
+    current_step = None
+
+    def __init__(self, lost=(), ages=None):
+        self._lost = list(lost)
+        self._ages = dict(ages or {})
+        self.left = False
+
+    def lost_peers(self):
+        return list(self._lost)
+
+    def peer_ages(self):
+        return dict(self._ages)
+
+    def remove_peers(self, ranks):
+        self._lost = [r for r in self._lost if r not in set(ranks)]
+
+    def alive(self):
+        return [0]
+
+    def world_size(self):
+        return 1
+
+    def become_coordinator(self):
+        return self
+
+    def barrier(self, tag, timeout=None):
+        return {}
+
+    def leave(self):
+        self.left = True
+
+    def stop(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# membership side channel
+# ---------------------------------------------------------------------------
+
+def test_membership_heartbeat_and_peer_loss():
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+        assert m0.lost_peers() == [] and m1.lost_peers() == []
+        assert 0 in m1.peer_ages()
+        # SIGKILL analog: rank 1 just goes silent
+        m1.stop()
+        assert _wait_until(lambda: m0.lost_peers() == [1], timeout=3.0)
+        assert m0.alive() == [0] and m0.world_size() == 1
+        # the verdict helper sees the same ages the coordinator tracks
+        v = stall_verdict(m0)
+        assert v['verdict'] == 'peer_loss_suspected' and v['lost'] == [1]
+        assert v['peer_ages'][1] > m0.deadline_seconds
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_membership_graceful_leave_is_not_a_loss():
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+        m1.leave()
+        assert _wait_until(lambda: m0.world_size() == 1, timeout=3.0)
+        # departed, not failed: never counted lost
+        time.sleep(3 * m0.deadline_seconds / 2)
+        assert m0.lost_peers() == []
+        assert (m0.view() or {}).get('left') == [1]
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_membership_barrier_skips_lost_peers():
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+        m1.stop()
+        assert _wait_until(lambda: m0.lost_peers() == [1], timeout=3.0)
+        # a barrier over {alive} must complete with only rank 0 arriving
+        view = m0.barrier('reform', timeout=3.0)
+        assert view['barrier_done']
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_membership_barrier_tag_reuse_resynchronizes():
+    """A reused tag (kvstore's fixed 'kvstore') must rendezvous EVERY
+    time — completion bumps a generation and clears the arrivals, so
+    round 2 cannot be satisfied by round 1's ghosts."""
+    import threading
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+
+        def round_trip():
+            out = []
+            t = threading.Thread(
+                target=lambda: out.append(m1.barrier('kvstore',
+                                                     timeout=5.0)))
+            t.start()
+            v = m0.barrier('kvstore', timeout=5.0)
+            t.join(5.0)
+            return v, out
+
+        v, out = round_trip()
+        assert v['barrier_done'] and out and out[0]['barrier_done']
+        # round 2, one rank only: must WAIT (not trivially complete)
+        with pytest.raises(MXNetError, match='timed out'):
+            m0.barrier('kvstore', timeout=0.7)
+        # ...and completes once the other rank arrives too
+        assert m1.barrier('kvstore', timeout=5.0)['barrier_done']
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_controller_reform_survives_coordinator_loss(tmp_path):
+    """Kill the membership COORDINATOR (rank 0): the survivor must not
+    resurrect it from a stale view — it promotes itself, re-forms at
+    world 1 and resumes (the drill only kills a non-coordinator)."""
+    m0, m1 = _pair(_free_port(), heartbeat=0.05, deadline=0.5)
+    try:
+        assert _wait_until(lambda: 0 in m1.peer_ages())
+        x, y = _batch()
+        net, step = _tiny('cl', make_mesh((2,), ('dp',)))
+        mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                           trainer=step, async_save=False)
+        ctl = ElasticController(manager=mgr, membership=m1, step=step)
+        for i in range(2):
+            step(x, y)
+            ctl.beat(i + 1)
+        m0.stop()   # the coordinator dies
+        assert _wait_until(lambda: m1.lost_peers() == [0], timeout=3.0)
+        resumed = ctl.pre_step()
+        assert resumed == 2
+        assert ctl.last_reform['world'] == 1
+        assert ctl.last_reform['rank'] == 0      # compacted, not [0, 1]
+        assert m1.is_coordinator                 # inherited the channel
+        # the retired coordinator is never re-declared lost
+        assert ctl.pre_step() is None
+        assert 0 not in m1.peer_ages()           # no -inf leakage
+        post = float(step(x, y).asnumpy())
+        assert onp.isfinite(post)
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_worker_declares_silent_coordinator_lost():
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: 0 in m1.peer_ages())
+        m0.stop()   # coordinator dies
+        assert _wait_until(lambda: m1.lost_peers() == [0], timeout=3.0)
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_heartbeat_fault_site_drops_beats():
+    """dist.heartbeat:raise makes a live worker LOOK dead — the
+    deterministic peer-loss drill (satellite: fault sites)."""
+    assert 'dist.heartbeat' in faults.sites()
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+        faults.arm('dist.heartbeat', 'raise')   # every beat, both ranks
+        assert _wait_until(lambda: 1 in m0.lost_peers(), timeout=3.0)
+        # the victim's sender thread survived its injected raises
+        assert m1.send_failures >= 1 or faults.active()
+    finally:
+        faults.disarm()
+        m0.stop()
+        m1.stop()
+
+
+def test_barrier_fault_site_fires_on_kvstore_barrier():
+    assert 'dist.barrier' in faults.sites()
+    faults.arm('dist.barrier', 'raise')
+    kv = mx.kv.create('local')
+    with pytest.raises(faults.InjectedFault):
+        kv.barrier()
+    faults.disarm()
+    kv.barrier()   # disarmed: clean
+    # the dist kvstore path fires the same site (single-process: no
+    # membership rendezvous, same deterministic drill point)
+    faults.arm('dist.barrier', 'raise')
+    kvd = mx.kv.create('dist_sync')
+    with pytest.raises(faults.InjectedFault):
+        kvd.barrier()
+
+
+# ---------------------------------------------------------------------------
+# dist.init hardening (satellite: bounded retry + logged fallback)
+# ---------------------------------------------------------------------------
+
+def test_dist_init_retries_coordinator_race(monkeypatch):
+    calls = []
+
+    def flaky_init(**kwargs):
+        calls.append(kwargs)
+        if len(calls) < 3:
+            raise RuntimeError('DEADLINE_EXCEEDED: coordinator not '
+                               'yet listening')
+
+    import jax
+    monkeypatch.setattr(jax.distributed, 'initialize', flaky_init)
+    monkeypatch.setattr(dist, '_initialized', False)
+    monkeypatch.setenv('MXNET_TPU_COORDINATOR', 'localhost:29599')
+    dist.init(num_processes=2, process_id=1)
+    assert len(calls) == 3   # two transient failures, then success
+    assert calls[0]['coordinator_address'] == 'localhost:29599'
+    monkeypatch.setattr(dist, '_initialized', False)
+
+
+def test_dist_init_retry_budget_exhausts(monkeypatch):
+    def always_down(**kwargs):
+        raise RuntimeError('UNAVAILABLE: connection refused')
+
+    import jax
+    monkeypatch.setattr(jax.distributed, 'initialize', always_down)
+    monkeypatch.setattr(dist, '_initialized', False)
+    monkeypatch.setenv('MXTPU_DIST_INIT_RETRIES', '1')
+    monkeypatch.setenv('MXNET_TPU_COORDINATOR', 'localhost:29599')
+    with pytest.raises(RuntimeError, match='UNAVAILABLE'):
+        dist.init(num_processes=2, process_id=1)
+    monkeypatch.setattr(dist, '_initialized', False)
+
+
+def test_dist_init_fatal_errors_not_retried(monkeypatch):
+    """A double init / bad-argument RuntimeError is permanent — it must
+    fail immediately, not burn the backoff budget as 'transient'."""
+    calls = []
+
+    def double_init(**kwargs):
+        calls.append(1)
+        raise RuntimeError('distributed.initialize should only be '
+                           'called once.')
+
+    import jax
+    monkeypatch.setattr(jax.distributed, 'initialize', double_init)
+    monkeypatch.setattr(dist, '_initialized', False)
+    monkeypatch.setenv('MXNET_TPU_COORDINATOR', 'localhost:29599')
+    with pytest.raises(MXNetError, match='non-transient'):
+        dist.init(num_processes=2, process_id=1)
+    assert len(calls) == 1
+    monkeypatch.setattr(dist, '_initialized', False)
+
+
+def test_membership_restarts_after_stop():
+    """start() after stop() must spawn live threads (the stop event is
+    cleared), e.g. the become_coordinator promotion path."""
+    m = dist.Membership(0, 1, port=_free_port(), heartbeat_seconds=0.05,
+                        deadline_seconds=0.5)
+    try:
+        assert _wait_until(lambda: m._view is not None)
+        m.stop()
+        m._view = None
+        m.start()
+        assert _wait_until(lambda: m._view is not None, timeout=2.0)
+    finally:
+        m.stop()
+
+
+def test_dmlc_coordinator_fallback_warns(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING, logger='mxnet_tpu.dist'):
+        assert dist._dmlc_coordinator() == 'localhost:12345'
+    msg = '\n'.join(r.message for r in caplog.records)
+    # the warning must NAME the env vars it looked for
+    assert 'MXNET_TPU_COORDINATOR' in msg and 'DMLC_PS_ROOT_URI' in msg
+
+
+def test_single_process_init_is_silent(monkeypatch, caplog):
+    """A plain single-process dist.init() needs no coordinator at all —
+    the localhost-fallback warning must not fire."""
+    import logging
+    monkeypatch.setattr(dist, '_initialized', False)
+    for var in ('MXNET_TPU_COORDINATOR', 'MXNET_TPU_NUM_PROCS',
+                'DMLC_PS_ROOT_URI'):
+        monkeypatch.delenv(var, raising=False)
+    with caplog.at_level(logging.WARNING, logger='mxnet_tpu.dist'):
+        dist.init()
+    assert not caplog.records
+    monkeypatch.setattr(dist, '_initialized', False)
+
+
+def test_dmlc_coordinator_env_is_silent(monkeypatch, caplog):
+    import logging
+    monkeypatch.setenv('DMLC_PS_ROOT_URI', '10.0.0.1')
+    monkeypatch.setenv('DMLC_PS_ROOT_PORT', '9999')
+    with caplog.at_level(logging.WARNING, logger='mxnet_tpu.dist'):
+        assert dist._dmlc_coordinator() == '10.0.0.1:9999'
+    assert not caplog.records
+
+
+# ---------------------------------------------------------------------------
+# watchdog verdict (satellite: peer loss vs local stall)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_verdict_peer_loss_vs_local_stall():
+    reports = []
+    wd = resilience.StepWatchdog(
+        deadline_seconds=0.2, poll_seconds=0.05,
+        on_stall=reports.append,
+        membership=StubMembership(lost=[1], ages={1: 7.5}))
+    with wd:
+        assert _wait_until(lambda: reports, timeout=3.0)
+    assert 'PEER LOSS SUSPECTED' in reports[0]
+    assert '7.5' in reports[0] and '[1]' in reports[0]
+
+    reports.clear()
+    wd = resilience.StepWatchdog(
+        deadline_seconds=0.2, poll_seconds=0.05,
+        on_stall=reports.append,
+        membership=StubMembership(lost=[], ages={1: 0.04}))
+    with wd:
+        assert _wait_until(lambda: reports, timeout=3.0)
+    assert 'LOCAL STALL' in reports[0]
+    assert 'PEER LOSS' not in reports[0]
+
+
+def test_stall_verdict_none_without_membership():
+    assert dist.membership() is None
+    assert stall_verdict() is None
+
+
+# ---------------------------------------------------------------------------
+# controller: preemption + re-form
+# ---------------------------------------------------------------------------
+
+def _tiny(prefix, mesh, lr=0.05):
+    net = gluon.nn.HybridSequential(prefix=f'{prefix}_')
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation='relu', prefix='fc1_'),
+                gluon.nn.Dense(2, prefix='fc2_'))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss_fn, 'adam', {'learning_rate': lr},
+                            mesh=mesh)
+    return net, step
+
+
+def _batch(seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.randn(32, 8).astype(onp.float32)
+    return nd.array(x), nd.array((x.sum(1) > 0).astype(onp.float32))
+
+
+def test_controller_preemption_commits_and_raises(tmp_path):
+    x, y = _batch()
+    net, step = _tiny('pre', make_mesh((4,), ('dp',)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                       trainer=step, async_save=False)
+    ctl = ElasticController(manager=mgr, membership=StubMembership())
+    ctl.attach_step(step)
+    for i in range(3):
+        step(x, y)
+        ctl.beat(i + 1)
+    ctl.install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)   # the preemption notice
+        time.sleep(0.01)                       # handler runs in-thread
+    finally:
+        ctl.uninstall()
+    assert ctl.preempt_requested
+    with pytest.raises(Preempted, match='resumable from step 3'):
+        ctl.pre_step()
+    assert mgr.latest_step() == 3
+    assert ctl.membership.left   # graceful goodbye, not a peer loss
+    ctl.close()
+
+
+def test_controller_reform_resumes_bit_identical(tmp_path):
+    """Peer loss -> commit -> reset_mesh at a smaller world -> restore:
+    the post-re-form trajectory must equal a clean restore of the same
+    checkpoint on the same (new) mesh."""
+    x, y = _batch()
+    net, step = _tiny('ref', make_mesh((4,), ('dp',)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                       trainer=step, async_save=False)
+    ms = StubMembership(lost=[1], ages={1: 9.9})
+    ctl = ElasticController(manager=mgr, membership=ms, step=step,
+                            mesh_fn=lambda w, r: make_mesh((2,), ('dp',)))
+    for i in range(3):
+        step(x, y)
+        ctl.beat(i + 1)
+    resumed = ctl.pre_step()
+    assert resumed == 3 and ctl.reforms == 1 and ctl.peer_losses == 1
+    assert ctl.last_reform['world'] == 1
+    assert dict(step.mesh.shape)['dp'] == 2
+    post = [float(step(x, y).asnumpy()) for _ in range(3)]
+    # second pre_step: loss retired, nothing to do
+    assert ctl.pre_step() is None
+
+    # clean-restore twin (identical param names via the same prefix)
+    net2, step2 = _tiny('ref', make_mesh((2,), ('dp',)))
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path), params=net2,
+                                        trainer=step2, async_save=False)
+    assert mgr2.restore_latest() == 3
+    post2 = [float(step2(x, y).asnumpy()) for _ in range(3)]
+    assert post == post2
+    # the committed manifest records the world it was written under
+    ck = mgr2.restore(3, apply=False)
+    assert ck.metadata['world']['processes'] == 1
+
+
+def test_controller_reform_telemetry(tmp_path, monkeypatch):
+    monkeypatch.setenv('MXNET_TPU_TELEMETRY', '1')
+    from mxnet_tpu.base import telem_flags
+    monkeypatch.setitem(telem_flags, 'on', True)
+    from mxnet_tpu import telemetry
+    x, y = _batch()
+    net, step = _tiny('tel', make_mesh((2,), ('dp',)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                       trainer=step, async_save=False)
+    ms = StubMembership(lost=[1], ages={1: 3.0})
+    ctl = ElasticController(manager=mgr, membership=ms, step=step)
+    step(x, y)
+    ctl.beat(1)
+    before_losses = telemetry.value(
+        'mxnet_tpu_elastic_peer_losses_total') or 0
+    before_reforms = telemetry.value('mxnet_tpu_elastic_reforms_total') or 0
+    assert ctl.pre_step() == 1
+    assert telemetry.value(
+        'mxnet_tpu_elastic_peer_losses_total') == before_losses + 1
+    assert telemetry.value(
+        'mxnet_tpu_elastic_reforms_total') == before_reforms + 1
+    assert telemetry.value('mxnet_tpu_elastic_last_world_size') == 1
+
+
+def test_reset_mesh_carries_state_across_dp_change():
+    """reset_mesh alone (no checkpoint round-trip): ZeRO shards re-form
+    from dp=4 to dp=2 through the layout-independent states payload."""
+    x, y = _batch()
+    mx.random.seed(11)
+    net, step = _tiny('rm', make_mesh((4,), ('dp',)))
+    l0 = [float(step(x, y).asnumpy()) for _ in range(3)]
+    step.reset_mesh(make_mesh((2,), ('dp',)))
+    assert step._dp_size == 2 and step._compiled is None
+    l1 = [float(step(x, y).asnumpy()) for _ in range(2)]
+    # uninterrupted twin at dp=4 (identically seeded init + RNG stream)
+    mx.random.seed(11)
+    net2, step2 = _tiny('rm', make_mesh((4,), ('dp',)))
+    l2 = [float(step2(x, y).asnumpy()) for _ in range(5)]
+    assert l0 == l2[:3]
+    # same bound as the zero1 parity suite: batch-reduction reorder
+    assert max(abs(a - b) for a, b in zip(l1, l2[3:])) <= 1e-6
+
+
+def test_step_dispatch_refuses_doomed_collective(monkeypatch):
+    x, y = _batch()
+    net, step = _tiny('pl', make_mesh((2,), ('dp',)))
+    step(x, y)   # build + one clean step
+    monkeypatch.setattr(step, '_spans_processes', True)
+    monkeypatch.setattr(dist, '_membership',
+                        StubMembership(lost=[3], ages={3: 12.0}))
+    with pytest.raises(PeerLossError, match='rank 3'):
+        step(x, y)
+    monkeypatch.setattr(dist, '_membership', None)
+    step(x, y)   # membership gone -> dispatch proceeds
+
+
+def test_trainer_attach_elastic_preemption(tmp_path):
+    from mxnet_tpu import autograd
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                       async_save=False)
+    ctl = ElasticController(manager=mgr, membership=StubMembership())
+    assert trainer.attach_elastic(ctl) is ctl
+    x, y = _batch()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(32)          # healthy: a normal step
+    # the trainer feeds the commit point itself — no explicit beat()
+    # in user loops, or the elastic commit would capture a stale step
+    assert ctl.last_step == 1
+    ctl.preempt_requested = True
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    with pytest.raises(Preempted):
+        trainer.step(32)      # unmodified user loop, clean exit path
+    assert mgr.latest_step() == 1
+
+
+# ---------------------------------------------------------------------------
+# the e2e drill (satellite: multi-process elastic drill in CI)
+# ---------------------------------------------------------------------------
+
+def test_elastic_drill_kill_one_of_two_workers(tmp_path):
+    """Spawn 2 subprocess workers, SIGKILL one mid-step: the survivor
+    must detect within the peer deadline, commit, re-form at world
+    size 1, and resume bit-identical to a clean restore of the same
+    checkpoint (full acceptance path, MTTR measured)."""
+    result = run_drill(str(tmp_path))
+    assert result['ok'] and result['bit_identical']
+    assert result['post_steps'] >= 1
+    mttr = result['mttr']
+    # detection bounded by deadline + heartbeat/step slack (run_drill
+    # asserts the exact budget); phases all measured and sane
+    assert 0 < mttr['detect_seconds'] < 10
+    assert mttr['reform_seconds'] < 5
+    assert mttr['total_seconds'] < 20

@@ -102,6 +102,19 @@ SUBSYSTEM_METRICS = {
         # ZeRO-3 1/dp param residency is auditable against it
         'mxnet_tpu_comm_param_bytes_per_device': 'gauge',
     },
+    'mxnet_tpu_elastic_': {
+        # elastic multi-host training (membership side channel +
+        # commit/re-form/resume controller): heartbeat round-trips
+        # sent, peers declared lost past MXTPU_PEER_DEADLINE_SECONDS,
+        # completed mesh re-forms, the survivor world size after the
+        # newest re-form, and the detect->commit->teardown->restore
+        # wall time of each re-form (the MTTR the CPU drill records)
+        'mxnet_tpu_elastic_heartbeats_total': 'counter',
+        'mxnet_tpu_elastic_peer_losses_total': 'counter',
+        'mxnet_tpu_elastic_reforms_total': 'counter',
+        'mxnet_tpu_elastic_last_world_size': 'gauge',
+        'mxnet_tpu_elastic_reform_seconds': 'histogram',
+    },
     'mxnet_tpu_trace_': {
         # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
         # dropped by ring overwrite, events currently buffered across
