@@ -5,15 +5,20 @@ state on the training thread, writes atomically (per-array files + a
 hashed JSON manifest committed by one ``os.replace``) on a background
 thread, enforces keep-last-N / keep-every-K retention, and resumes via
 hash-verified ``restore_latest()`` with fallback to the previous
-committed step on corruption. See manager.py / manifest.py, the README
-"Checkpointing" section, and ``tools/check_checkpoint_manifest.py``.
+committed step on corruption. ``replica.ReplicaManager`` adds the
+survivability layer: background peer replication of every committed
+step over the membership side channel, an integrity scrubber with
+quarantine + repair, and an any-replica restore fallback. See
+manager.py / manifest.py / replica.py, the README "Checkpointing"
+section, and ``tools/check_checkpoint_manifest.py``.
 """
 from .manifest import (CorruptCheckpointError, atomic_write_bytes,
                        committed_steps, read_manifest, step_dir_name,
                        validate_step_dir)
 from .manager import CheckpointManager, RestoredCheckpoint
+from .replica import ReplicaManager, ReplicaPeer
 
-__all__ = ['CheckpointManager', 'RestoredCheckpoint',
-           'CorruptCheckpointError', 'atomic_write_bytes',
+__all__ = ['CheckpointManager', 'RestoredCheckpoint', 'ReplicaManager',
+           'ReplicaPeer', 'CorruptCheckpointError', 'atomic_write_bytes',
            'committed_steps', 'read_manifest', 'step_dir_name',
            'validate_step_dir']

@@ -174,7 +174,8 @@ class CheckpointManager:
                  keep_last_n: int = 3, keep_every_k_steps: Optional[int] = None,
                  autosave_steps: Optional[int] = None,
                  autosave_seconds: Optional[float] = None,
-                 async_save: bool = True, save_rng: bool = True):
+                 async_save: bool = True, save_rng: bool = True,
+                 replication: Optional[bool] = None):
         if keep_last_n < 1:
             raise MXNetError("keep_last_n must be >= 1 (the latest "
                              "checkpoint can never be retention-expired)")
@@ -205,6 +206,27 @@ class CheckpointManager:
         # or a half-finished same-step re-save swap (recovered) behind;
         # nothing of ours is in flight yet, so pid-reuse leftovers go too
         self._recover_and_sweep(sweep_own=True)
+        # peer replication (ISSUE 10): auto-attached when
+        # MXTPU_CHECKPOINT_REPLICAS > 0 and an elastic membership world
+        # is running (pass replication=False to force it off, or attach
+        # an explicitly constructed ReplicaManager for custom worlds)
+        self._replica = None
+        if replication is None or replication:
+            try:
+                from .. import config as _config
+                want = int(_config.get('MXTPU_CHECKPOINT_REPLICAS')) > 0 \
+                    if replication is None else True
+                if want:
+                    from ..parallel import dist as _dist
+                    ms = _dist.membership()
+                    if ms is not None and ms.world > 1:
+                        from .replica import ReplicaManager
+                        self._replica = ReplicaManager(self, rank=ms.rank)
+            except Exception as e:   # replication must never kill a run
+                warnings.warn(
+                    f"checkpoint replication unavailable: {e!r}",
+                    RuntimeWarning)
+                self._replica = None
 
     # -- introspection ----------------------------------------------------
 
@@ -217,6 +239,29 @@ class CheckpointManager:
 
     def step_dir(self, step: int) -> str:
         return os.path.join(self.directory, mf.step_dir_name(step))
+
+    # -- replication -------------------------------------------------------
+
+    @property
+    def replica(self):
+        """The attached ReplicaManager (None when replication is off)."""
+        return self._replica
+
+    @property
+    def last_restore_source(self):
+        """Where the last restore's bytes came from: None (plain local
+        restore) or the replica source description (e.g.
+        ``hosted:rank0``) when the any-replica fallback fetched them."""
+        return self._replica.last_restore_source \
+            if self._replica is not None else None
+
+    def attach_replication(self, replica_manager) -> None:
+        """Attach an explicitly constructed
+        ``checkpoint.replica.ReplicaManager`` (tests, drills, custom
+        peer worlds). Replaces — and closes — any auto-attached one."""
+        if self._replica is not None and self._replica is not replica_manager:
+            self._replica.close()
+        self._replica = replica_manager
 
     # -- save -------------------------------------------------------------
 
@@ -390,6 +435,11 @@ class CheckpointManager:
             except OSError:
                 pass
             return
+        if self._replica is not None:
+            # hand the committed step to the background push worker:
+            # one lock + list append — replication never blocks the
+            # writer thread (let alone the training thread)
+            self._replica.enqueue(snap['step'])
         if _telem['on']:
             from .. import telemetry as _telemetry
             _telemetry.observe('mxnet_tpu_checkpoint_save_seconds',
@@ -484,11 +534,23 @@ class CheckpointManager:
         writers) — never the in-flight write."""
         steps = self.all_steps()
         keep = self._retained(steps)
-        removed = 0
-        for s in steps:
-            if s not in keep:
-                shutil.rmtree(self.step_dir(s), ignore_errors=True)
-                removed += 1
+        expired = [s for s in steps if s not in keep]
+        for s in expired:
+            shutil.rmtree(self.step_dir(s), ignore_errors=True)
+        removed = len(expired)
+        if expired and self._replica is not None:
+            # retention must also retire the steps' peer-hosted
+            # replicas, or they grow unboundedly (background, bounded;
+            # a peer's own orphan-GC scrub reconciles missed deletes)
+            self._replica.retire(expired)
+        # quarantined copies (scrub/restore corruption evidence) expire
+        # with their step's retention: evidence for a RETAINED step is
+        # kept (bounded by the keep-set size), everything else goes —
+        # a min-step cutoff would never fire once keep_every_k_steps
+        # pins an old step forever
+        for qpath, qstep in mf.quarantined_dirs(self.directory):
+            if qstep not in keep:
+                shutil.rmtree(qpath, ignore_errors=True)
         removed_tmp = self._recover_and_sweep(sweep_own=True)
         if removed and _telem['on']:
             from .. import telemetry as _telemetry
@@ -530,11 +592,23 @@ class CheckpointManager:
         one fails validation. With ``apply=True`` (default) the restored
         state is written into the bound ``params`` / ``trainer`` and the
         RNG stream, and the step number is returned; with ``apply=False``
-        the raw ``RestoredCheckpoint`` is returned instead."""
+        the raw ``RestoredCheckpoint`` is returned instead.
+
+        With replication attached the scan gains an **any-replica
+        fallback**: a corrupt local step is quarantined and repaired
+        from a healthy replica BEFORE falling back to an older local
+        step (the newest intact copy may be remote), and a missing or
+        fully corrupt local directory inventories the live peers and
+        fetches the newest commonly-committed step — hash-verified and
+        committed locally — so a host that lost its disk still resumes."""
         self.wait()
         steps = self.all_steps()
         if not steps:
-            return None
+            fetched = self._replica_fetch_latest()
+            if fetched is None:
+                return None
+            steps = [fetched]
+        repaired = set()
         for step in reversed(steps):
             try:
                 return self.restore(step, apply=apply, strict=strict,
@@ -543,13 +617,66 @@ class CheckpointManager:
                 if _telem['on']:
                     from .. import telemetry as _telemetry
                     _telemetry.inc('mxnet_tpu_checkpoint_corrupt_total')
+                if self._replica is not None and step not in repaired:
+                    repaired.add(step)
+                    warnings.warn(
+                        f"checkpoint step {step} failed validation "
+                        f"({e}); quarantining and repairing from a "
+                        f"replica", RuntimeWarning)
+                    if self._try_replica_repair(step):
+                        try:
+                            return self.restore(step, apply=apply,
+                                                strict=strict,
+                                                restore_rng=restore_rng)
+                        except CorruptCheckpointError as e2:
+                            e = e2
                 warnings.warn(
                     f"checkpoint step {step} failed validation, falling "
                     f"back to the previous committed step: {e}",
                     RuntimeWarning)
+        fetched = self._replica_fetch_latest()
+        if fetched is not None:
+            try:
+                return self.restore(fetched, apply=apply, strict=strict,
+                                    restore_rng=restore_rng)
+            except CorruptCheckpointError:
+                pass
         raise CorruptCheckpointError(
             f"no checkpoint under {self.directory} passed validation "
-            f"(tried steps {list(reversed(steps))})")
+            f"(tried steps {list(reversed(steps))})"
+            + ("" if self._replica is None
+               else " and no peer replica was usable either"))
+
+    def _replica_fetch_latest(self):
+        """Fetch the newest step any replica source holds into the
+        local directory (None without replication / nothing usable)."""
+        if self._replica is None:
+            return None
+        try:
+            return self._replica.fetch_latest_into_local()
+        except Exception as e:
+            warnings.warn(f"any-replica restore fallback failed: {e!r}",
+                          RuntimeWarning)
+            return None
+
+    def _try_replica_repair(self, step) -> bool:
+        """Quarantine one corrupt local step and re-fetch it from a
+        healthy replica (restore-time twin of the scrubber's repair)."""
+        d = self.step_dir(step)
+        q = f'{d}.quarantine-{os.getpid()}'
+        try:
+            if os.path.isdir(d):
+                if os.path.isdir(q):
+                    shutil.rmtree(q, ignore_errors=True)
+                os.replace(d, q)
+        except OSError:
+            pass
+        try:
+            return self._replica.repair_step(step)
+        except Exception as e:
+            warnings.warn(f"replica repair of step {step} failed: {e!r}",
+                          RuntimeWarning)
+            return False
 
     def restore(self, step: int, apply: bool = True, strict: bool = True,
                 restore_rng: bool = True):
@@ -587,11 +714,19 @@ class CheckpointManager:
 
         def _read_verified(entry):
             path = os.path.join(d, entry['file'])
+            # fault site: 'corrupt' mangles the bytes AFTER the disk
+            # read so the hash check below rejects them (deterministic
+            # corrupt-restore drills — no hand-flipped bytes); 'raise'
+            # is wrapped like any other read failure, so the restore
+            # scan falls back / repairs instead of aborting
+            kind = _faults.fire('checkpoint.read')
             try:
                 with open(path, 'rb') as f:
                     data = f.read()
             except OSError as e:
                 raise CorruptCheckpointError(f"{path}: {e}")
+            if kind == 'corrupt':
+                data = _faults.corrupt_bytes(data)
             if len(data) != entry['bytes'] or \
                     mf.sha256_bytes(data) != entry['sha256']:
                 raise CorruptCheckpointError(
@@ -687,8 +822,12 @@ class CheckpointManager:
     # -- lifecycle --------------------------------------------------------
 
     def close(self) -> None:
-        """Flush the in-flight write and unhook signals."""
+        """Flush the in-flight write and unhook signals (and shut the
+        replication worker + scrubber + replica server down)."""
         self.wait()
+        if self._replica is not None:
+            self._replica.close()
+            self._replica = None
         self.uninstall_preemption_hook()
 
     def __enter__(self):

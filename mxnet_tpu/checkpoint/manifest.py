@@ -40,6 +40,13 @@ TMP_SUFFIX_RE = re.compile(r'^step_\d{10}\.tmp-\d+$')
 # a committed dir retired aside while a re-save of the same step swaps in
 # (recoverable: if the swap died, the old copy is renamed back on startup)
 OLD_DIR_RE = re.compile(r'^(step_\d{10})\.old-\d+$')
+# a committed dir the scrubber (or a replica repair) moved aside after a
+# hash mismatch: evidence for the post-mortem, never a restore target
+QUARANTINE_DIR_RE = re.compile(r'^(step_(\d{10}))\.quarantine-\d+$')
+# directory holding replicas this host stores on behalf of PEER ranks
+# (one <REPLICA_SUBDIR>/<ns>/step_* tree per owner); dot-prefixed so
+# committed_steps / the retention GC never confuse it with local steps
+REPLICA_SUBDIR = '.replicas'
 
 
 class CorruptCheckpointError(_BaseError):
@@ -146,6 +153,64 @@ def read_manifest(dirpath: str) -> dict:
     return doc
 
 
+def scan_step_dir(dirpath: str, read_bytes=None):
+    """Full integrity scan of one committed checkpoint dir.
+
+    Re-hashes every payload file named by the manifest and checks byte
+    counts. Returns ``(doc_or_None, [(kind, detail), ...])`` where
+    ``kind`` classifies each problem as ``'missing'`` (a payload file
+    the manifest names is absent) or ``'corrupt'`` (unreadable/
+    malformed manifest, byte-count or content-hash mismatch) — the
+    distinction the scrub CLI's exit codes report.
+
+    ``read_bytes``: optional ``callable(path) -> bytes`` replacing the
+    default streamed ``sha256_file`` — the ONE seam through which the
+    background scrubber injects its ``checkpoint.read`` fault site and
+    idle pacing, so there is exactly one integrity scanner over the
+    manifest format. Exceptions it raises count as corrupt."""
+    try:
+        doc = read_manifest(dirpath)
+    except CorruptCheckpointError as e:
+        return None, [('corrupt', str(e))]
+    problems = []
+    entries = list(doc.get('arrays', [])) + list(doc.get('blobs', []))
+    if not isinstance(doc.get('step'), int):
+        problems.append(('corrupt', "manifest carries no integer 'step'"))
+    for e in entries:
+        rel = e.get('file')
+        if not rel or '..' in rel.split('/'):
+            problems.append(
+                ('corrupt',
+                 f"entry {e.get('name')!r}: bad file path {rel!r}"))
+            continue
+        path = os.path.join(dirpath, rel)
+        if not os.path.isfile(path):
+            problems.append(('missing', f"{rel}: missing"))
+            continue
+        if read_bytes is not None:
+            try:
+                data = read_bytes(path)
+            except Exception as exc:  # read failure / injected fault
+                problems.append(('corrupt', f"{rel}: {exc}"))
+                continue
+            size, digest = len(data), sha256_bytes(data)
+        else:
+            size, digest = os.path.getsize(path), None
+        if size != e.get('bytes'):
+            problems.append(
+                ('corrupt',
+                 f"{rel}: size {size} != manifest {e.get('bytes')}"))
+            continue
+        if digest is None:
+            digest = sha256_file(path)
+        if digest != e.get('sha256'):
+            problems.append(
+                ('corrupt',
+                 f"{rel}: sha256 {digest[:12]}... != manifest "
+                 f"{str(e.get('sha256'))[:12]}..."))
+    return doc, problems
+
+
 def validate_step_dir(dirpath: str):
     """Full integrity check of one committed checkpoint dir.
 
@@ -153,33 +218,11 @@ def validate_step_dir(dirpath: str):
     counts. Returns the parsed manifest; raises CorruptCheckpointError
     naming every problem found (all problems, not just the first, so the
     CLI tool's report is actionable)."""
-    doc = read_manifest(dirpath)
-    problems = []
-    entries = list(doc.get('arrays', [])) + list(doc.get('blobs', []))
-    if not isinstance(doc.get('step'), int):
-        problems.append("manifest carries no integer 'step'")
-    for e in entries:
-        rel = e.get('file')
-        if not rel or '..' in rel.split('/'):
-            problems.append(f"entry {e.get('name')!r}: bad file path {rel!r}")
-            continue
-        path = os.path.join(dirpath, rel)
-        if not os.path.isfile(path):
-            problems.append(f"{rel}: missing")
-            continue
-        size = os.path.getsize(path)
-        if size != e.get('bytes'):
-            problems.append(
-                f"{rel}: size {size} != manifest {e.get('bytes')}")
-            continue
-        digest = sha256_file(path)
-        if digest != e.get('sha256'):
-            problems.append(
-                f"{rel}: sha256 {digest[:12]}... != manifest "
-                f"{str(e.get('sha256'))[:12]}...")
+    doc, problems = scan_step_dir(dirpath)
     if problems:
         raise CorruptCheckpointError(
-            f"checkpoint {dirpath} corrupt: " + '; '.join(problems))
+            f"checkpoint {dirpath} corrupt: "
+            + '; '.join(detail for _kind, detail in problems))
     return doc
 
 
@@ -205,6 +248,34 @@ def stale_tmp_dirs(root: str):
     except OSError:
         return []
     return [os.path.join(root, n) for n in names if TMP_SUFFIX_RE.match(n)]
+
+
+def quarantined_dirs(root: str):
+    """[(path, step), ...] for ``step_*.quarantine-<pid>`` dirs — copies
+    the scrubber (or a replica repair) retired after a hash mismatch.
+    Kept as evidence until their step falls out of retention."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = QUARANTINE_DIR_RE.match(n)
+        if m:
+            out.append((os.path.join(root, n), int(m.group(2))))
+    return out
+
+
+def replica_namespaces(root: str):
+    """Sorted owner namespaces (e.g. ``rank0``) with hosted replicas
+    under ``<root>/.replicas``."""
+    base = os.path.join(root, REPLICA_SUBDIR)
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return []
+    return sorted(n for n in names
+                  if os.path.isdir(os.path.join(base, n)))
 
 
 def stale_old_dirs(root: str):

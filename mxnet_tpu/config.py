@@ -243,6 +243,33 @@ register('MXTPU_BARRIER_TIMEOUT_SECONDS', float, 60.0,
          'Timeout of the elastic membership barrier (dist.barrier): '
          'how long a rank waits for every live peer to arrive at the '
          'same tag before raising.')
+register('MXTPU_CHECKPOINT_REPLICAS', int, 1,
+         'Checkpoint survivability: how many PEER hosts each committed '
+         'checkpoint step is replicated to over the membership side '
+         'channel (ring order over the live ranks). 0 disables '
+         'replication. Replication runs entirely off the training '
+         'thread — a dead or slow peer can never stall a commit.')
+register('MXTPU_REPLICA_PORT_BASE', int, 0,
+         'Base TCP port of the per-rank checkpoint replica servers '
+         '(rank r listens on base + r). 0 (default) derives the elastic '
+         'side-channel port + 100, so parallel jobs on one host do not '
+         'collide.')
+register('MXTPU_REPLICA_BANDWIDTH_MBPS', float, 0.0,
+         'Cap on checkpoint replication transfer bandwidth in MB/s '
+         '(paced per chunk on the sending side, so a replication push '
+         'never saturates the NIC a training job shares). 0 (default) '
+         'is uncapped.')
+register('MXTPU_REPLICA_TIMEOUT_SECONDS', float, 10.0,
+         'Socket timeout of every replica-transport op (file_put / '
+         'file_get / inventory / commit / delete). Bounds how long a '
+         'dead peer can hold a replication worker or a replica-restore '
+         'fetch — never the training thread.')
+register('MXTPU_SCRUB_SECONDS', float, 300.0,
+         'Background checkpoint scrubber cadence: every this many '
+         'seconds the scrubber re-hashes one pass over the committed '
+         'local steps and hosted peer replicas, quarantines mismatches '
+         'and repairs them from a healthy replica. 0 disables the '
+         'scrubber thread (scrub_once() remains callable).')
 
 
 def _zero_stage(s):

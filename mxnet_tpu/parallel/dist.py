@@ -27,6 +27,8 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
+import shutil
 import socket
 import subprocess
 import sys
@@ -760,6 +762,438 @@ def barrier(tag='barrier', timeout=None):
         _faults.fire('dist.barrier')
         return None
     return _membership.barrier(tag, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint replica transport (ISSUE 10)
+# ---------------------------------------------------------------------------
+#
+# Chunked file transfer on the SAME lightweight TCP side-channel design
+# as the membership layer — deliberately never the ICI collectives,
+# which are exactly what a dead peer wedges. One request per
+# connection: a JSON header line, then (file_put) exactly `size` raw
+# bytes, then a JSON reply line (file_get replies stream `size` raw
+# bytes after the header). The receiver stages every file of a step
+# into a ``step_*.tmp-<pid>`` dir and makes it visible only through
+# ``replica_commit``'s single os.replace — the same commit protocol as
+# a local checkpoint write, so a kill -9 at ANY point mid-transfer
+# leaves no partial replica visible.
+
+_REPLICA_CHUNK = 1 << 20          # 1 MiB transfer chunks
+_NS_RE = re.compile(r'^[A-Za-z0-9][A-Za-z0-9_.\-]*$')
+
+
+def _replica_timeout(timeout=None):
+    from .. import config as _config
+    return float(timeout) if timeout is not None \
+        else float(_config.get('MXTPU_REPLICA_TIMEOUT_SECONDS'))
+
+
+def replica_port(rank, coordinator=None):
+    """Replica-server port of ``rank``: MXTPU_REPLICA_PORT_BASE + rank,
+    defaulting the base to the elastic side-channel port + 100 (keeps
+    parallel jobs on one host from colliding, same scheme as
+    ``_elastic_port``)."""
+    from .. import config as _config
+    base = int(_config.get('MXTPU_REPLICA_PORT_BASE') or 0)
+    if not base:
+        base = _elastic_port(coordinator) + 100
+    return base + int(rank)
+
+
+def _safe_rel(rel):
+    rel = str(rel)
+    if not rel or rel.startswith(('/', '\\')) or '..' in rel.split('/') \
+            or '\\' in rel:
+        raise MXNetError(f"replica transport: unsafe relative path {rel!r}")
+    return rel
+
+
+def _safe_ns(ns):
+    ns = str(ns)
+    if not _NS_RE.match(ns):
+        raise MXNetError(f"replica transport: bad namespace {ns!r}")
+    return ns
+
+
+def _recv_exact(f, n, chunk=_REPLICA_CHUNK):
+    out = bytearray()
+    while len(out) < n:
+        b = f.read(min(chunk, n - len(out)))
+        if not b:
+            raise OSError(f"replica transport: connection closed after "
+                          f"{len(out)}/{n} bytes")
+        out += b
+    return bytes(out)
+
+
+class ReplicaServer:
+    """Per-rank checkpoint replica endpoint.
+
+    Stores replicas pushed by PEER ranks under
+    ``<root>/<ns>/step_*`` (``ns`` names the owner, e.g. ``rank0``) and
+    serves reads of both those hosted replicas and — when ``local_dir``
+    is given — this host's OWN committed checkpoints (``ns='local'``),
+    so a survivor can restore a dead owner's state from any live host.
+
+    Ops (one JSON header line per connection):
+
+    - ``file_put``  {ns, step, rel, size, sha256} + raw bytes: stage one
+      payload file into the step's uncommitted tmp dir (hash-verified
+      on receipt).
+    - ``replica_commit`` {ns, step}: validate the staged dir against its
+      manifest and publish it with one os.replace.
+    - ``file_get``  {ns, step, rel}: stream one file back.
+    - ``replica_inventory`` [{ns}]: committed hosted steps per namespace
+      plus the owner's own local committed steps.
+    - ``replica_delete`` {ns, step}: retire a hosted replica (retention
+      GC from the owner) — counted in
+      ``mxnet_tpu_checkpoint_replica_gc_total``.
+    """
+
+    def __init__(self, root, local_dir=None, port=0, start=True):
+        self.root = os.path.abspath(root)
+        self.local_dir = local_dir
+        os.makedirs(self.root, exist_ok=True)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._server = None
+        self._threads = []
+        self.port = int(port)
+        self.gc_total = 0
+        self._sweep_stale()
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._server is not None:
+            return self
+        self._stop.clear()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(('', self.port))
+        self.port = srv.getsockname()[1]
+        srv.listen(16)
+        srv.settimeout(0.2)
+        self._server = srv
+        t = threading.Thread(target=self._serve, daemon=True,
+                             name='mxtpu-replica-server')
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _sweep_stale(self):
+        """Sweep staging leftovers of a killed predecessor: nothing is
+        in flight when a fresh server starts, so every ``*.tmp-*`` under
+        every namespace is a dead write."""
+        from ..checkpoint import manifest as mf
+        try:
+            namespaces = os.listdir(self.root)
+        except OSError:
+            return
+        for ns in namespaces:
+            nsdir = os.path.join(self.root, ns)
+            if not os.path.isdir(nsdir):
+                continue
+            for tmp in mf.stale_tmp_dirs(nsdir):
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- server loop -------------------------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # one thread per connection: a bandwidth-paced multi-MB put
+            # must not block inventory/fetch ops from other peers
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 daemon=True, name='mxtpu-replica-conn')
+            t.start()
+
+    def _handle_conn(self, conn):
+        try:
+            conn.settimeout(_replica_timeout())
+            with conn, conn.makefile('rwb') as f:
+                line = f.readline()
+                if not line:
+                    return
+                try:
+                    msg = json.loads(line.decode())
+                    reply, payload = self._handle(msg, f)
+                except MXNetError as e:
+                    reply, payload = {'ok': 0, 'error': str(e)}, None
+                except (OSError, ValueError, KeyError, TypeError) as e:
+                    reply, payload = {'ok': 0, 'error': repr(e)}, None
+                f.write(json.dumps(reply).encode() + b'\n')
+                if payload is not None:
+                    f.write(payload)
+                f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def _ns_dir(self, ns, create=False):
+        d = os.path.join(self.root, _safe_ns(ns))
+        if create:
+            os.makedirs(d, exist_ok=True)
+        return d
+
+    def _step_root(self, ns, step):
+        """(namespace dir, final step dir) — ns 'local' reads this
+        host's own checkpoint directory (read-only ops)."""
+        from ..checkpoint import manifest as mf
+        if ns == 'local':
+            if self.local_dir is None:
+                raise MXNetError("replica server: no local checkpoint "
+                                 "dir attached (ns='local' unavailable)")
+            base = self.local_dir
+        else:
+            base = self._ns_dir(ns)
+        return base, os.path.join(base, mf.step_dir_name(int(step)))
+
+    def _handle(self, msg, f):
+        """Returns (reply dict, optional raw payload bytes)."""
+        from ..checkpoint import manifest as mf
+        op = msg.get('op')
+        if op == 'file_put':
+            ns = _safe_ns(msg['ns'])
+            if ns == 'local':
+                raise MXNetError("replica server: refusing file_put into "
+                                 "the local checkpoint dir")
+            rel = _safe_rel(msg['rel'])
+            size = int(msg['size'])
+            data = _recv_exact(f, size)
+            digest = mf.sha256_bytes(data)
+            if digest != msg.get('sha256'):
+                raise MXNetError(
+                    f"replica file_put {ns}/{msg.get('step')}/{rel}: "
+                    f"content hash mismatch in transfer "
+                    f"({digest[:12]}... != "
+                    f"{str(msg.get('sha256'))[:12]}...)")
+            nsdir = self._ns_dir(ns, create=True)
+            staging = os.path.join(
+                nsdir, mf.step_dir_name(int(msg['step']))
+                + f'.tmp-{os.getpid()}')
+            path = os.path.join(staging, rel)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            mf.write_bytes_durable(path, data)
+            return {'ok': 1, 'bytes': size}, None
+        if op == 'replica_commit':
+            ns = _safe_ns(msg['ns'])
+            if ns == 'local':
+                raise MXNetError("replica server: refusing commit into "
+                                 "the local checkpoint dir")
+            step = int(msg['step'])
+            nsdir = self._ns_dir(ns, create=True)
+            final = os.path.join(nsdir, mf.step_dir_name(step))
+            staging = final + f'.tmp-{os.getpid()}'
+            with self._lock:
+                if not os.path.isdir(staging):
+                    raise MXNetError(
+                        f"replica commit {ns}/{step}: no staged files")
+                try:
+                    mf.validate_step_dir(staging)
+                except mf.CorruptCheckpointError as e:
+                    shutil.rmtree(staging, ignore_errors=True)
+                    raise MXNetError(
+                        f"replica commit {ns}/{step} failed validation "
+                        f"(staging discarded): {e}")
+                if os.path.isdir(final):
+                    old = final + f'.old-{os.getpid()}'
+                    if os.path.isdir(old):
+                        shutil.rmtree(old)
+                    os.replace(final, old)
+                    os.replace(staging, final)
+                    shutil.rmtree(old, ignore_errors=True)
+                else:
+                    os.replace(staging, final)
+                mf.fsync_dir(nsdir)
+            return {'ok': 1, 'step': step}, None
+        if op == 'file_get':
+            ns = _safe_ns(msg['ns'])
+            rel = _safe_rel(msg['rel'])
+            _, stepdir = self._step_root(ns, msg['step'])
+            path = os.path.join(stepdir, rel)
+            try:
+                with open(path, 'rb') as pf:
+                    data = pf.read()
+            except OSError as e:
+                raise MXNetError(f"replica file_get "
+                                 f"{ns}/{msg.get('step')}/{rel}: {e}")
+            return {'ok': 1, 'size': len(data),
+                    'sha256': mf.sha256_bytes(data)}, data
+        if op == 'replica_inventory':
+            want = msg.get('ns')
+            hosted = {}
+            try:
+                namespaces = sorted(os.listdir(self.root))
+            except OSError:
+                namespaces = []
+            for ns in namespaces:
+                if not os.path.isdir(os.path.join(self.root, ns)):
+                    continue
+                if want and ns != want:
+                    continue
+                hosted[ns] = mf.committed_steps(
+                    os.path.join(self.root, ns))
+            local = mf.committed_steps(self.local_dir) \
+                if self.local_dir else []
+            return {'ok': 1, 'hosted': hosted, 'local': local}, None
+        if op == 'replica_delete':
+            ns = _safe_ns(msg['ns'])
+            if ns == 'local':
+                raise MXNetError("replica server: refusing delete in "
+                                 "the local checkpoint dir")
+            _, stepdir = self._step_root(ns, msg['step'])
+            removed = 0
+            with self._lock:
+                if os.path.isdir(stepdir):
+                    shutil.rmtree(stepdir, ignore_errors=True)
+                    removed = 1
+            if removed:
+                self.gc_total += 1
+                if _telem['on']:
+                    from .. import telemetry as _telemetry
+                    _telemetry.inc(
+                        'mxnet_tpu_checkpoint_replica_gc_total')
+            return {'ok': 1, 'removed': removed}, None
+        raise MXNetError(f"replica server: unknown op {op!r}")
+
+
+def _replica_request(host, port, msg, payload=None, timeout=None,
+                     bandwidth_mbps=None, recv_payload=False):
+    """One replica-transport round-trip. ``payload`` bytes are streamed
+    chunked after the header (paced to ``bandwidth_mbps`` when set);
+    ``recv_payload`` reads the reply's ``size`` bytes after the reply
+    header. Bounded by the socket timeout at every read/write — a dead
+    peer costs one timeout, never a hang."""
+    timeout = _replica_timeout(timeout)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            with conn.makefile('rwb') as f:
+                f.write(json.dumps(msg).encode() + b'\n')
+                if payload is not None:
+                    pace = None
+                    if bandwidth_mbps is None:
+                        from .. import config as _config
+                        bandwidth_mbps = _config.get(
+                            'MXTPU_REPLICA_BANDWIDTH_MBPS')
+                    if bandwidth_mbps and bandwidth_mbps > 0:
+                        pace = 1.0 / (float(bandwidth_mbps) * 1e6)
+                    view = memoryview(payload)
+                    for off in range(0, len(view), _REPLICA_CHUNK):
+                        t0 = _time.perf_counter()
+                        chunk = view[off:off + _REPLICA_CHUNK]
+                        f.write(chunk)
+                        f.flush()
+                        if pace:
+                            budget = len(chunk) * pace
+                            spent = _time.perf_counter() - t0
+                            if budget > spent:
+                                _time.sleep(budget - spent)
+                f.flush()
+                line = f.readline()
+                if not line:
+                    raise OSError("connection closed before reply")
+                reply = json.loads(line.decode())
+                data = None
+                if recv_payload and reply.get('ok'):
+                    data = _recv_exact(f, int(reply['size']))
+    except (OSError, ValueError) as e:
+        raise MXNetError(
+            f"replica transport: {host}:{port} {msg.get('op')} failed: "
+            f"{e!r}") from e
+    if not reply.get('ok'):
+        raise MXNetError(
+            f"replica transport: {host}:{port} {msg.get('op')} "
+            f"rejected: {reply.get('error')}")
+    return (reply, data) if recv_payload else reply
+
+
+def file_put(host, port, ns, step, rel, data, timeout=None,
+             bandwidth_mbps=None):
+    """Push one payload file of a committed step to a peer's replica
+    server (staged — invisible until ``replica_commit``). Fault site
+    ``dist.file_put``: raise fails the transfer, corrupt mangles the
+    bytes in flight (the receiver's hash check rejects them), hang
+    stalls into the socket timeout."""
+    from ..resilience import faults as _faults
+    kind = _faults.fire('dist.file_put')
+    sent = bytes(data)
+    if kind == 'corrupt':
+        sent = _faults.corrupt_bytes(sent)
+    from ..checkpoint import manifest as mf
+    return _replica_request(
+        host, port,
+        {'op': 'file_put', 'ns': ns, 'step': int(step), 'rel': rel,
+         'size': len(sent), 'sha256': mf.sha256_bytes(bytes(data))},
+        payload=sent, timeout=timeout, bandwidth_mbps=bandwidth_mbps)
+
+
+def file_get(host, port, ns, step, rel, timeout=None):
+    """Fetch one file of a hosted replica (or, with ``ns='local'``, of
+    the peer's own committed checkpoint). Returns the raw bytes after
+    verifying the transfer hash."""
+    from ..checkpoint import manifest as mf
+    reply, data = _replica_request(
+        host, port,
+        {'op': 'file_get', 'ns': ns, 'step': int(step), 'rel': rel},
+        timeout=timeout, recv_payload=True)
+    if mf.sha256_bytes(data) != reply.get('sha256'):
+        raise MXNetError(
+            f"replica transport: {ns}/{step}/{rel} from {host}:{port} "
+            f"corrupted in transfer (hash mismatch)")
+    return data
+
+
+def replica_commit(host, port, ns, step, timeout=None):
+    """Publish a fully staged replica step with one os.replace on the
+    receiver (validated against its manifest first)."""
+    return _replica_request(
+        host, port, {'op': 'replica_commit', 'ns': ns, 'step': int(step)},
+        timeout=timeout)
+
+
+def replica_inventory(host, port, ns=None, timeout=None):
+    """{'hosted': {ns: [steps]}, 'local': [steps]} of a peer's replica
+    server — the restore-fallback / orphan-GC survey op."""
+    msg = {'op': 'replica_inventory'}
+    if ns is not None:
+        msg['ns'] = ns
+    return _replica_request(host, port, msg, timeout=timeout)
+
+
+def replica_delete(host, port, ns, step, timeout=None):
+    """Retire one hosted replica step on a peer (retention GC)."""
+    return _replica_request(
+        host, port, {'op': 'replica_delete', 'ns': ns, 'step': int(step)},
+        timeout=timeout)
 
 
 def launch_local(script, n=2, env=None, coordinator='localhost:29500',

@@ -46,6 +46,24 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _free_port_base(n=2, tries=32):
+    """A base port with ``n`` consecutive free ports (the replica
+    servers listen on base + rank)."""
+    for _ in range(tries):
+        base = _free_port()
+        ok = True
+        for off in range(n):
+            try:
+                with socket.socket() as s:
+                    s.bind(('', base + off))
+            except OSError:
+                ok = False
+                break
+        if ok:
+            return base
+    raise RuntimeError("no consecutive free port range found")
+
+
 def _data_for(step, batch=16, dim=8):
     """Deterministic per-step batch: the same step index produces the
     same bytes in every process — the precondition for bit-identical
@@ -57,7 +75,7 @@ def _data_for(step, batch=16, dim=8):
     return x, y
 
 
-def _build(workdir, rank, mesh):
+def _build(workdir, rank, mesh, autosave_steps=None, replication=False):
     """Model + compiled step + checkpoint manager for one worker.
     Explicit prefixes: every process (workers, the reference run) must
     produce identical parameter names for the states payload to apply."""
@@ -77,7 +95,9 @@ def _build(workdir, rank, mesh):
                             {'learning_rate': 0.05}, mesh=mesh)
     mgr = _checkpoint.CheckpointManager(
         os.path.join(workdir, f'ckpt-rank{rank}'),
-        params=net, trainer=step, async_save=False)
+        params=net, trainer=step, async_save=False,
+        autosave_steps=autosave_steps,
+        replication=None if replication else False)
     return net, step, mgr
 
 
@@ -106,8 +126,18 @@ def _worker(args):
                                heartbeat_seconds=args.heartbeat,
                                deadline_seconds=args.deadline)
     mesh = make_mesh(devices=jax.local_devices())
-    net, step, mgr = _build(args.workdir, rank, mesh)
-    ctl = ElasticController(manager=mgr, membership=ms, step=step)
+    # disk-loss mode: ONE rank owns the checkpoint directory (the
+    # standard multi-host pattern — payloads are host-gathered, one
+    # writer suffices) and commits every step; every rank runs the
+    # replica server, so the owner's commits land on its peers
+    owner = args.ckpt_owner if args.disk_loss else None
+    is_owner = owner is None or rank == owner
+    net, step, mgr = _build(
+        args.workdir, rank, mesh,
+        autosave_steps=1 if (args.disk_loss and is_owner) else None,
+        replication=args.disk_loss)
+    ctl = ElasticController(manager=mgr, membership=ms, step=step,
+                            commit_on_reform=is_owner)
     ctl.start_monitor()
 
     marks = {'rank': rank, 'start_wall': _time.time()}
@@ -119,6 +149,7 @@ def _worker(args):
             marks['reform'] = ctl.last_reform
             marks['reform_done_wall'] = _time.time()
             marks['resumed_step'] = resumed
+            marks['restore_source'] = mgr.last_restore_source
             i = int(resumed)
             continue
         t0 = _time.perf_counter()
@@ -126,6 +157,10 @@ def _worker(args):
         dt = _time.perf_counter() - t0
         i += 1
         ctl.beat(i)
+        if args.disk_loss and is_owner:
+            mgr.maybe_save(i)
+            if mgr.replica is not None:
+                mgr.replica.wait(timeout=10.0)   # drill determinism only
         losses[i] = float(loss).hex()
         if 'reform' in marks:
             post[i] = float(loss).hex()
@@ -170,6 +205,35 @@ def _reference(args):
     mgr.close()
 
 
+def _hosted_steps(nsdir):
+    """Committed step numbers under one hosted-replica namespace dir."""
+    try:
+        import re
+        return sorted(int(m.group(1)) for m in
+                      (re.match(r'^step_(\d{10})$', n)
+                       for n in os.listdir(nsdir)) if m)
+    except OSError:
+        return []
+
+
+def _assert_dirs_bit_identical(a, b):
+    """Every file under ``a`` must exist under ``b`` with identical
+    bytes (and vice versa) — the replica-restore parity check."""
+    def walk(root):
+        out = {}
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                p = os.path.join(dirpath, fn)
+                out[os.path.relpath(p, root)] = p
+        return out
+    fa, fb = walk(a), walk(b)
+    assert sorted(fa) == sorted(fb), (sorted(fa), sorted(fb))
+    for rel in fa:
+        with open(fa[rel], 'rb') as f1, open(fb[rel], 'rb') as f2:
+            assert f1.read() == f2.read(), \
+                f"{rel} differs between {a} and {b}"
+
+
 def _wait_progress(path, target, timeout):
     deadline = _time.monotonic() + timeout
     while _time.monotonic() < deadline:
@@ -184,12 +248,21 @@ def _wait_progress(path, target, timeout):
 
 
 def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
-              step_sleep=0.35, timeout=180.0, victim_rank=1):
+              step_sleep=0.35, timeout=180.0, victim_rank=1,
+              disk_loss=False):
     """Run the two-worker SIGKILL drill. Returns a dict with the
     survivor's MTTR phase breakdown and the bit-parity verdict (raises
-    AssertionError on any broken guarantee)."""
+    AssertionError on any broken guarantee).
+
+    ``disk_loss=True`` is the survivability variant (ISSUE 10): the
+    victim rank OWNS the checkpoint directory (commits every step,
+    replicated to the peer over the side channel) and its directory is
+    **wiped before the SIGKILL** — so the survivor can only resume by
+    fetching the newest replicated step from its own hosted replica,
+    hash-verified, bit-identical to a clean local restore."""
     os.makedirs(workdir, exist_ok=True)
     jax_port, side_port = _free_port(), _free_port()
+    replica_base = _free_port_base(2) if disk_loss else 0
     env = dict(os.environ)
     env.update({
         'PYTHONPATH': os.pathsep.join(
@@ -207,10 +280,20 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
         'MXTPU_HEARTBEAT_SECONDS': str(heartbeat),
         'MXTPU_PEER_DEADLINE_SECONDS': str(deadline),
     })
+    if disk_loss:
+        env.update({
+            # exercise the AUTO wiring: CheckpointManager attaches the
+            # ReplicaManager itself off the membership world + env knobs
+            'MXTPU_CHECKPOINT_REPLICAS': '1',
+            'MXTPU_REPLICA_PORT_BASE': str(replica_base),
+            'MXTPU_REPLICA_TIMEOUT_SECONDS': '5',
+        })
     base = [sys.executable, '-m', 'mxnet_tpu.resilience.drill',
             '--workdir', workdir, '--steps', str(steps),
             '--port', str(side_port), '--heartbeat', str(heartbeat),
             '--deadline', str(deadline), '--step-sleep', str(step_sleep)]
+    if disk_loss:
+        base += ['--disk-loss', '--ckpt-owner', str(victim_rank)]
     procs, logs = [], []
     for r in range(2):
         e = dict(env)
@@ -244,6 +327,25 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
                 os.path.join(workdir, f'progress-rank{r}.txt'),
                 kill_at, timeout / 2):
             _fail(f"drill: rank {r} never reached step {kill_at}")
+    victim_ckpt = os.path.join(workdir, f'ckpt-rank{victim_rank}')
+    hosted = os.path.join(workdir, f'ckpt-rank{survivor_rank}',
+                          '.replicas', f'rank{victim_rank}')
+    if disk_loss:
+        # the survivor must already hold a committed replica of the
+        # owner's checkpoints before the disaster strikes
+        deadline_t = _time.monotonic() + timeout / 2
+        while _time.monotonic() < deadline_t:
+            if _hosted_steps(hosted):
+                break
+            _time.sleep(0.05)
+        else:
+            _fail(f"drill: no committed replica under {hosted}")
+        # the disaster: the preemption takes the owner's DISK with it —
+        # wipe the whole checkpoint dir (local steps AND its replica
+        # root), then SIGKILL. The survivor's only restore source is
+        # now its own hosted replica.
+        import shutil
+        shutil.rmtree(victim_ckpt, ignore_errors=True)
     victim.kill()                       # SIGKILL: no goodbye, no flush
     kill_wall = _time.time()
     victim.wait()
@@ -262,6 +364,19 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
     assert res['reforms'] == 1 and res['peer_losses'] == 1, res
     assert marks.get('reform', {}).get('world') == 1, marks
     assert res['post'], "survivor recorded no post-re-form steps"
+    if disk_loss:
+        # the restore bytes must have come through the replica path
+        # (there is no other source: the owner's dir was wiped and the
+        # survivor never committed) — and the fetched local step must
+        # be bit-identical to the hosted replica copy it came from
+        src = marks.get('restore_source')
+        assert src and src.startswith(f'hosted:rank{victim_rank}'), (
+            "survivor did not restore from a peer replica", marks)
+        resumed = int(marks['resumed_step'])
+        _assert_dirs_bit_identical(
+            os.path.join(workdir, f'ckpt-rank{survivor_rank}',
+                         f'step_{resumed:010d}'),
+            os.path.join(hosted, f'step_{resumed:010d}'))
 
     # reference: clean restore of the SAME committed checkpoint
     ref_cmd = base + ['--reference', '--ref-rank', str(survivor_rank)]
@@ -302,6 +417,8 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
         'post_steps': len(res['post']),
         'bit_identical': True,
         'deadline_seconds': deadline,
+        'disk_loss': bool(disk_loss),
+        'restore_source': marks.get('restore_source'),
         'mttr': mttr,
     }
 
@@ -317,6 +434,8 @@ def main(argv=None):
     ap.add_argument('--deadline', type=float, default=1.2)
     ap.add_argument('--step-sleep', type=float, default=0.35)
     ap.add_argument('--ref-rank', type=int, default=0)
+    ap.add_argument('--disk-loss', action='store_true')
+    ap.add_argument('--ckpt-owner', type=int, default=None)
     args = ap.parse_args(argv)
     if args.worker:
         _worker(args)
@@ -326,7 +445,8 @@ def main(argv=None):
         print(json.dumps(run_drill(args.workdir, steps=args.steps,
                                    heartbeat=args.heartbeat,
                                    deadline=args.deadline,
-                                   step_sleep=args.step_sleep), indent=1))
+                                   step_sleep=args.step_sleep,
+                                   disk_loss=args.disk_loss), indent=1))
     return 0
 
 

@@ -106,26 +106,44 @@ def stall_verdict(membership=None):
     """Classify a stall: ``peer_loss`` (some peer's heartbeat age is
     past the deadline — the wedge is a REMOTE preemption) vs
     ``local_stall`` (every peer is beating — the wedge is local code).
-    Returns ``{'verdict', 'peer_ages', 'lost', 'deadline_seconds'}`` or
-    None when no membership layer is running (single-process jobs have
-    no peers to blame)."""
+    Returns ``{'verdict', 'peer_ages', 'lost', 'deadline_seconds'}``
+    (plus ``'during': 'replica_fetch'`` when a checkpoint replica fetch
+    is in flight — then the serving peer is the prime suspect even
+    while it still heartbeats, so the verdict is peer loss suspected,
+    not a bare local stall) or None when no membership layer is running
+    and nothing remote is in flight (single-process jobs have no peers
+    to blame)."""
+    fetching = 0
+    try:
+        from ..checkpoint import replica as _replica
+        fetching = _replica.active_fetches()
+    except Exception:
+        pass
     if membership is None:
         from ..parallel import dist as _dist
         membership = _dist.membership()
     if membership is None:
-        return None
+        if not fetching:
+            return None
+        return {'verdict': 'peer_loss_suspected', 'peer_ages': {},
+                'lost': [], 'deadline_seconds': 0.0,
+                'during': 'replica_fetch'}
     try:
         lost = membership.lost_peers()
         ages = membership.peer_ages()
     except Exception:
         return None
-    return {
-        'verdict': 'peer_loss_suspected' if lost else 'local_stall',
+    v = {
+        'verdict': 'peer_loss_suspected' if (lost or fetching)
+                   else 'local_stall',
         'peer_ages': {int(r): round(float(a), 3)
                       for r, a in ages.items()},
         'lost': [int(r) for r in lost],
         'deadline_seconds': membership.deadline_seconds,
     }
+    if fetching:
+        v['during'] = 'replica_fetch'
+    return v
 
 
 class ElasticController:
@@ -157,11 +175,19 @@ class ElasticController:
         others retarget their heartbeats at it. Default keeps the
         current host (correct when survivors share one, e.g. the CPU
         drill; multi-host deployments must supply the resolver).
+    commit_on_reform : bool
+        Whether a peer-loss re-form commits a checkpoint at this rank's
+        last completed step before restoring (default True). Set False
+        on ranks that do NOT own the checkpoint directory (deployments
+        where only one rank writes checkpoints): their re-form then
+        rolls straight back to the newest committed copy — which, when
+        the owner died WITH its disk, the any-replica restore fetches
+        from a hosted peer replica.
     """
 
     def __init__(self, manager, membership=None, step=None, trainer=None,
                  mesh_fn=None, reinit_fn=None, on_reform=None,
-                 coordinator_host_fn=None):
+                 coordinator_host_fn=None, commit_on_reform=True):
         self.manager = manager
         self._membership = membership
         self._steps = [step] if step is not None else []
@@ -169,6 +195,7 @@ class ElasticController:
         self.mesh_fn = mesh_fn
         self.reinit_fn = reinit_fn
         self.coordinator_host_fn = coordinator_host_fn
+        self.commit_on_reform = bool(commit_on_reform)
         self._on_reform_hooks = [on_reform] if on_reform else []
         self.preempt_requested = False
         self.last_step = None
@@ -376,8 +403,14 @@ class ElasticController:
         with _trace.span('elastic.reform', lost=len(lost)):
             # 1. commit: the survivors' restart point. States payloads
             # are host-gathered (PR-4/PR-7 layout independence), so this
-            # world's layout does not constrain who restores it.
-            committed = self._commit()
+            # world's layout does not constrain who restores it. Ranks
+            # that don't own the checkpoint dir (commit_on_reform=False)
+            # skip this and roll back to the newest committed copy —
+            # locally, or from a peer replica when the owner's disk died
+            # with it (manager.restore_latest's any-replica fallback).
+            committed = self._commit() if self.commit_on_reform else \
+                (self.manager.latest_step()
+                 if self.manager is not None else None)
             t_commit = _time.perf_counter()
             # 2. tear down the old world (bounded: the runtime's shutdown
             # barrier waits for the dead peer). Survivors are computed

@@ -83,6 +83,19 @@ _SITES = {
                          'retried as a transient FS error; corrupt '
                          'mangles one payload so restore falls back)',
                          ('raise', 'hang', 'corrupt')),
+    'checkpoint.read': ('CheckpointManager payload read at restore and '
+                        'scrub time (corrupt mangles the bytes AFTER the '
+                        'disk read so the hash check fails — restore '
+                        'falls back / repairs from a replica and the '
+                        'scrubber quarantines, no hand-flipped bytes '
+                        'needed; raise surfaces a hard read error)',
+                        ('raise', 'hang', 'corrupt')),
+    'dist.file_put': ('checkpoint replica transfer send (parallel.dist.'
+                      'file_put; raise fails the transfer — the push '
+                      'worker retries bounded; corrupt mangles the '
+                      'payload in flight so the receiver hash check '
+                      'rejects it; hang stalls the transfer into its '
+                      'socket timeout)', ('raise', 'hang', 'corrupt')),
     'collective.all_reduce': ('kvstore gradient reduction across device '
                               'copies', ('raise', 'hang')),
     'dist.heartbeat': ('elastic membership heartbeat send (parallel.dist.'

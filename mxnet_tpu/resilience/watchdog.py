@@ -162,6 +162,8 @@ class StepWatchdog:
                 note.update(verdict=verdict['verdict'],
                             peer_ages=verdict['peer_ages'],
                             lost_peers=verdict['lost'])
+                if verdict.get('during'):
+                    note['during'] = verdict['during']
             _flight.note('watchdog.stall', **note)
             path = _flight.dump(reason='watchdog_stall')
             if path:
@@ -217,14 +219,25 @@ class StepWatchdog:
         if verdict is None:
             verdict = self._stall_verdict()
         if verdict is not None:
+            during = ' (during replica fetch)' \
+                if verdict.get('during') == 'replica_fetch' else ''
             if verdict['lost']:
                 lines.insert(1, (
-                    f"verdict: PEER LOSS SUSPECTED — peer(s) "
+                    f"verdict: PEER LOSS SUSPECTED{during} — peer(s) "
                     f"{verdict['lost']} silent past the "
                     f"{verdict['deadline_seconds']:.1f}s membership "
                     f"deadline (last-heartbeat ages per peer: "
                     f"{verdict['peer_ages']}); the wedge is most likely "
                     f"a remote preemption, not local code."))
+            elif during:
+                lines.insert(1, (
+                    f"verdict: PEER LOSS SUSPECTED{during} — a "
+                    f"checkpoint replica fetch has been in flight for "
+                    f"the whole stall; the serving peer is the prime "
+                    f"suspect even though it still heartbeats "
+                    f"(last-heartbeat ages per peer: "
+                    f"{verdict['peer_ages']}). The fetch itself is "
+                    f"bounded by MXTPU_REPLICA_TIMEOUT_SECONDS."))
             else:
                 lines.insert(1, (
                     f"verdict: LOCAL STALL — every peer is still "

@@ -1,0 +1,764 @@
+"""Checkpoint survivability (ISSUE 10): peer replication over the
+membership-style TCP side channel, background integrity scrubbing with
+quarantine + bit-identical repair, any-replica restore, and the crash
+matrix (receiver killed mid-transfer, sender killed between local
+commit and replication, scrubber vs an injected bit flip)."""
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import (CheckpointManager, ReplicaManager,
+                                  ReplicaPeer)
+from mxnet_tpu.checkpoint import manifest as mf
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.resilience.elastic import stall_verdict
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+PARAMS = {'w': onp.arange(12, dtype=onp.float32).reshape(3, 4),
+          'b': onp.full((4,), 7.0, onp.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    faults.disarm()
+    dist.stop_membership()
+
+
+def _pair(tmp_path, **rm_a_kw):
+    """Two managers with cross-wired replication: a pushes to b."""
+    mgr_a = CheckpointManager(str(tmp_path / 'a'), async_save=False,
+                              replication=False)
+    mgr_b = CheckpointManager(str(tmp_path / 'b'), async_save=False,
+                              replication=False)
+    rm_b = ReplicaManager(mgr_b, rank=1, peers=[], port=0,
+                          scrub_seconds=0, resync=False)
+    rm_a = ReplicaManager(
+        mgr_a, rank=0, peers=[(1, '127.0.0.1', rm_b.server.port)],
+        port=0, scrub_seconds=0, resync=False, **rm_a_kw)
+    mgr_a.attach_replication(rm_a)
+    mgr_b.attach_replication(rm_b)
+    return mgr_a, mgr_b, rm_a, rm_b
+
+
+def _hosted_dir(mgr_b):
+    return os.path.join(mgr_b.directory, mf.REPLICA_SUBDIR, 'rank0')
+
+
+def _payload_file(mgr, step):
+    return os.path.join(mgr.step_dir(step), 'arrays', 'a00000.nd')
+
+
+def _flip_byte(path, offset=None):
+    with open(path, 'r+b') as f:
+        data = f.read()
+        off = len(data) // 2 if offset is None else offset
+        f.seek(off)
+        f.write(bytes([data[off] ^ 0x01]))
+
+
+# ---------------------------------------------------------------------------
+# replication push
+# ---------------------------------------------------------------------------
+
+def test_replication_pushes_committed_steps_to_peer(tmp_path):
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        mgr_a.save(2, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        hosted = _hosted_dir(mgr_b)
+        assert mf.committed_steps(hosted) == [1, 2]
+        for s in (1, 2):
+            mf.validate_step_dir(
+                os.path.join(hosted, mf.step_dir_name(s)))
+        # the replica is BIT-identical to the local commit
+        for rel in ('manifest.json', 'arrays/a00000.nd'):
+            with open(os.path.join(mgr_a.step_dir(2), rel), 'rb') as f1, \
+                    open(os.path.join(hosted, mf.step_dir_name(2), rel),
+                         'rb') as f2:
+                assert f1.read() == f2.read()
+        inv = dist.replica_inventory('127.0.0.1', rm_b.server.port)
+        assert inv['hosted'] == {'rank0': [1, 2]}
+        assert inv['local'] == []
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_slow_or_dead_peer_never_stalls_commit(tmp_path):
+    """Acceptance: replication is fully off the training thread — a
+    black-hole peer (accepts nothing, the connect queues in the listen
+    backlog and every read times out) costs the push worker one bounded
+    timeout per attempt, while save() returns at local-commit speed and
+    restore stays local-fast."""
+    hole = socket.socket()
+    hole.bind(('127.0.0.1', 0))
+    hole.listen(0)          # never accepted: reads time out client-side
+    try:
+        mgr_a = CheckpointManager(str(tmp_path / 'a'), async_save=False,
+                                  replication=False)
+        rm_a = ReplicaManager(
+            mgr_a, rank=0, peers=[(1, '127.0.0.1',
+                                   hole.getsockname()[1])],
+            port=0, scrub_seconds=0, resync=False, timeout=0.3)
+        mgr_a.attach_replication(rm_a)
+        t0 = time.perf_counter()
+        mgr_a.save(1, params=PARAMS, block=True)
+        save_wall = time.perf_counter() - t0
+        assert save_wall < 0.25, \
+            f"save() waited on the dead peer ({save_wall:.3f}s)"
+        assert rm_a.wait(30), "push worker wedged on the dead peer"
+        assert rm_a.push_failures >= 1
+        # restore is untouched by the dead peer: local copy is intact
+        t0 = time.perf_counter()
+        ck = mgr_a.restore_latest(apply=False)
+        assert ck.step == 1
+        assert time.perf_counter() - t0 < 1.0
+        mgr_a.close()
+    finally:
+        hole.close()
+
+
+def test_hang_injected_transfer_never_stalls_commit(tmp_path, monkeypatch):
+    """Acceptance: dist.file_put:hang stalls the TRANSFER (push worker),
+    not the training thread — save() returns immediately and the queue
+    still drains once the hang elapses."""
+    monkeypatch.setenv('MXTPU_FAULT_HANG_SECONDS', '0.4')
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        faults.arm('dist.file_put', 'hang', window=(1, 1))
+        t0 = time.perf_counter()
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert time.perf_counter() - t0 < 0.3, \
+            "save() waited on the hung transfer"
+        assert rm_a.wait(30)
+        assert mf.committed_steps(_hosted_dir(mgr_b)) == [1]
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_file_put_fault_raise_is_retried(tmp_path):
+    """dist.file_put:raise on the first transfer occurrence: the push
+    worker's bounded retry restages the step from scratch and the
+    replica still lands."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        faults.arm('dist.file_put', 'raise', window=(1, 1))
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        assert mf.committed_steps(_hosted_dir(mgr_b)) == [1]
+        assert faults.active()['dist.file_put']['fired'] == 1
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_file_put_fault_corrupt_is_rejected_then_retried(tmp_path):
+    """dist.file_put:corrupt mangles the bytes in flight: the receiver's
+    transfer hash check rejects them (no corrupt replica is ever
+    staged as valid) and the retry delivers clean bytes."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        faults.arm('dist.file_put', 'corrupt', window=(1, 1))
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        hosted = _hosted_dir(mgr_b)
+        assert mf.committed_steps(hosted) == [1]
+        mf.validate_step_dir(os.path.join(hosted, mf.step_dir_name(1)))
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# any-replica restore
+# ---------------------------------------------------------------------------
+
+def test_restore_latest_falls_back_to_replica_when_local_wiped(tmp_path):
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        mgr_a.save(2, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        for s in mgr_a.all_steps():
+            shutil.rmtree(mgr_a.step_dir(s))
+        ck = mgr_a.restore_latest(apply=False)
+        assert ck.step == 2
+        onp.testing.assert_array_equal(ck.params['w'], PARAMS['w'])
+        assert mgr_a.last_restore_source == 'peer:rank1/rank0'
+        # the fetch COMMITTED the step locally (hash-verified) — the
+        # next restore needs no peer at all
+        assert mgr_a.all_steps() == [2]
+        mf.validate_step_dir(mgr_a.step_dir(2))
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_restore_repairs_corrupt_newest_from_replica(tmp_path):
+    """A corrupt NEWEST local step is quarantined and repaired from the
+    replica before falling back to the older local step — the restore
+    resumes from the newest intact copy anywhere, not the newest local
+    one."""
+    telemetry.enable()
+    telemetry.reset()
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        p2 = {k: v + 1 for k, v in PARAMS.items()}
+        mgr_a.save(2, params=p2, block=True)
+        assert rm_a.wait(20)
+        _flip_byte(_payload_file(mgr_a, 2))
+        with pytest.warns(RuntimeWarning, match='repairing from a'):
+            ck = mgr_a.restore_latest(apply=False)
+        assert ck.step == 2, "fell back instead of repairing"
+        onp.testing.assert_array_equal(ck.params['w'], p2['w'])
+        assert telemetry.value(
+            'mxnet_tpu_checkpoint_replica_fetches_total') == 1
+        mf.validate_step_dir(mgr_a.step_dir(2))
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_checkpoint_read_fault_corrupt_falls_back_without_replica(tmp_path):
+    """The checkpoint.read fault site: 'corrupt' on the first restore
+    read mangles the bytes after the disk read, so the hash check fails
+    and restore_latest falls back to the previous committed step — the
+    corrupt-restore drill with no hand-flipped bytes."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False,
+                            replication=False)
+    mgr.save(1, params=PARAMS, block=True)
+    p2 = {k: v + 1 for k, v in PARAMS.items()}
+    mgr.save(2, params=p2, block=True)
+    faults.arm('checkpoint.read', 'corrupt', window=(1, 1))
+    with pytest.warns(RuntimeWarning, match='falling back'):
+        ck = mgr.restore_latest(apply=False)
+    assert ck.step == 1
+    onp.testing.assert_array_equal(ck.params['w'], PARAMS['w'])
+    mgr.close()
+
+
+def test_checkpoint_read_fault_with_replica_repairs_newest(tmp_path):
+    """Same drill with replication attached: the injected read
+    corruption triggers a repair fetch and the restore still lands on
+    the NEWEST step."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        p2 = {k: v + 1 for k, v in PARAMS.items()}
+        mgr_a.save(2, params=p2, block=True)
+        assert rm_a.wait(20)
+        faults.arm('checkpoint.read', 'corrupt', window=(1, 1))
+        with pytest.warns(RuntimeWarning, match='repairing from a'):
+            ck = mgr_a.restore_latest(apply=False)
+        assert ck.step == 2
+        onp.testing.assert_array_equal(ck.params['w'], p2['w'])
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# scrubber
+# ---------------------------------------------------------------------------
+
+def test_scrubber_detects_quarantines_and_repairs_bit_identical(tmp_path):
+    """Acceptance: the scrubber detects an injected bit flip in a
+    committed step, quarantines the corrupt copy and repairs it
+    BIT-identical from the peer replica."""
+    telemetry.enable()
+    telemetry.reset()
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        f = _payload_file(mgr_a, 1)
+        with open(f, 'rb') as fh:
+            pre = fh.read()
+        _flip_byte(f)
+        summary = rm_a.scrub_once()
+        assert summary['corrupt'] == 1 and summary['repaired'] == 1
+        with open(f, 'rb') as fh:
+            assert fh.read() == pre, "repair is not bit-identical"
+        qs = mf.quarantined_dirs(mgr_a.directory)
+        assert len(qs) == 1 and qs[0][1] == 1
+        assert telemetry.value(
+            'mxnet_tpu_checkpoint_scrub_corrupt_total') == 1
+        assert telemetry.value(
+            'mxnet_tpu_checkpoint_scrub_repaired_total') == 1
+        # a second pass over the repaired tree is clean
+        s2 = rm_a.scrub_once()
+        assert s2['corrupt'] == 0 and s2['local_checked'] == 1
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_scrubber_checkpoint_read_fault_site(tmp_path):
+    """checkpoint.read:corrupt at scrub time: the scrubber's re-hash
+    sees mangled bytes, quarantines the (actually fine) step and
+    repairs it from the replica — the scrub drill needs no real
+    bit-rot."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        faults.arm('checkpoint.read', 'corrupt', window=(1, 1))
+        summary = rm_a.scrub_once()
+        assert summary['corrupt'] == 1 and summary['repaired'] == 1
+        mf.validate_step_dir(mgr_a.step_dir(1))
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_scrubber_repairs_hosted_replica_from_owner(tmp_path):
+    """Bit-rot in a HOSTED replica: the host's scrubber re-fetches it
+    bit-identical from the owner's local copy."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        rm_b._peers = [ReplicaPeer(0, '127.0.0.1', rm_a.server.port)]
+        hf = os.path.join(_hosted_dir(mgr_b), mf.step_dir_name(1),
+                          'arrays', 'a00000.nd')
+        with open(_payload_file(mgr_a, 1), 'rb') as fh:
+            pre = fh.read()
+        _flip_byte(hf)
+        summary = rm_b.scrub_once()
+        assert summary['corrupt'] == 1 and summary['repaired'] == 1
+        with open(hf, 'rb') as fh:
+            assert fh.read() == pre
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# retention / GC
+# ---------------------------------------------------------------------------
+
+def test_retention_gc_retires_peer_replicas(tmp_path):
+    """keep_last_n GC must also retire the steps' peer-hosted replicas
+    (counted in mxnet_tpu_checkpoint_replica_gc_total) — replicas can't
+    grow unboundedly."""
+    telemetry.enable()
+    telemetry.reset()
+    mgr_a = CheckpointManager(str(tmp_path / 'a'), async_save=False,
+                              keep_last_n=2, replication=False)
+    mgr_b = CheckpointManager(str(tmp_path / 'b'), async_save=False,
+                              replication=False)
+    rm_b = ReplicaManager(mgr_b, rank=1, peers=[], port=0,
+                          scrub_seconds=0, resync=False)
+    rm_a = ReplicaManager(
+        mgr_a, rank=0, peers=[(1, '127.0.0.1', rm_b.server.port)],
+        port=0, scrub_seconds=0, resync=False)
+    mgr_a.attach_replication(rm_a)
+    mgr_b.attach_replication(rm_b)
+    try:
+        for s in range(1, 6):
+            mgr_a.save(s, params=PARAMS, block=True)
+        assert rm_a.wait(30)
+        assert mgr_a.all_steps() == [4, 5]
+        assert mf.committed_steps(_hosted_dir(mgr_b)) == [4, 5]
+        assert rm_b.server.gc_total >= 3
+        assert telemetry.value(
+            'mxnet_tpu_checkpoint_replica_gc_total') >= 3
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_orphaned_replicas_gc_on_scrub_but_only_when_owner_has_newer(
+        tmp_path):
+    """A hosted replica whose owner retired it while this host was down
+    is orphaned — GC'd by the next scrub pass. But when the owner has
+    NO committed steps at all (it lost its disk), hosted replicas are
+    precious and must never be treated as orphans."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(5, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        rm_b._peers = [ReplicaPeer(0, '127.0.0.1', rm_a.server.port)]
+        # fabricate an orphan: a hosted step the owner no longer has
+        hosted = _hosted_dir(mgr_b)
+        shutil.copytree(os.path.join(hosted, mf.step_dir_name(5)),
+                        os.path.join(hosted, mf.step_dir_name(1)))
+        summary = rm_b.scrub_once()
+        assert summary['orphans_gc'] == 1
+        assert mf.committed_steps(hosted) == [5]
+        # owner loses its disk entirely: nothing is orphaned anymore
+        shutil.rmtree(mgr_a.step_dir(5))
+        shutil.copytree(os.path.join(hosted, mf.step_dir_name(5)),
+                        os.path.join(hosted, mf.step_dir_name(1)))
+        summary = rm_b.scrub_once()
+        assert summary['orphans_gc'] == 0
+        assert mf.committed_steps(hosted) == [1, 5]
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+def test_quarantine_expiry_honors_keep_every_k(tmp_path):
+    """Quarantined copies expire when their STEP leaves retention —
+    including under keep_every_k_steps, where the oldest pinned step
+    would defeat any min-step cutoff."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False,
+                            keep_last_n=2, keep_every_k_steps=100,
+                            replication=False)
+    mgr.save(100, params=PARAMS, block=True)   # pinned forever by k=100
+    mgr.save(101, params=PARAMS, block=True)
+    # fabricate quarantines: one for a long-expired step, one for a
+    # retained step
+    for s in (5, 101):
+        q = mgr.step_dir(s) + f'.quarantine-{os.getpid()}'
+        os.makedirs(os.path.join(q, 'arrays'))
+    mgr.save(102, params=PARAMS, block=True)   # triggers _gc
+    left = {s for _p, s in mf.quarantined_dirs(mgr.directory)}
+    assert left == {101}, left                 # expired evidence swept
+    mgr.close()
+
+
+def test_fetch_rejects_traversal_paths_in_replica_manifest(tmp_path):
+    """A corrupt (or hostile) replica manifest naming '../...' payload
+    paths must never write outside the fetch staging dir — the fetch of
+    that step fails and the restore falls back to the next intact
+    replica step."""
+    import json
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    try:
+        mgr_a.save(1, params=PARAMS, block=True)
+        mgr_a.save(2, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        # poison the hosted replica of step 2: its manifest now names a
+        # payload path that, joined naively, would land in step 1's dir
+        hdir = os.path.join(_hosted_dir(mgr_b), mf.step_dir_name(2))
+        with open(os.path.join(hdir, mf.MANIFEST_NAME)) as f:
+            doc = json.load(f)
+        doc['arrays'][0]['file'] = '../step_0000000001/manifest.json'
+        with open(os.path.join(hdir, mf.MANIFEST_NAME), 'w') as f:
+            json.dump(doc, f)
+        # wipe ALL local steps: the any-replica restore must reject the
+        # poisoned step-2 replica and land on the clean step-1 replica
+        for s in mgr_a.all_steps():
+            shutil.rmtree(mgr_a.step_dir(s))
+        ck = mgr_a.restore_latest(apply=False)
+        assert ck.step == 1, "poisoned replica was not rejected"
+        onp.testing.assert_array_equal(ck.params['w'], PARAMS['w'])
+        # nothing escaped: the only local artifacts are step 1 and its
+        # (validated) contents
+        assert mgr_a.all_steps() == [1]
+        mf.validate_step_dir(mgr_a.step_dir(1))
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# crash matrix
+# ---------------------------------------------------------------------------
+
+def test_receiver_kill9_mid_transfer_leaves_no_partial_replica(tmp_path):
+    """Acceptance: kill -9 the RECEIVER mid-transfer — no partial
+    replica is ever visible (only uncommitted staging, swept on
+    restart), and the next replication to a fresh server over the same
+    root succeeds."""
+    root = str(tmp_path / 'replicas')
+    port = None
+    with socket.socket() as s:
+        s.bind(('', 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, '-m', 'mxnet_tpu.checkpoint.replica',
+             '--serve', '--root', root, '--port', str(port)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+        assert p.stdout.readline().strip() == 'ready'
+        return p
+
+    server = spawn()
+    try:
+        # a real committed step to replicate
+        mgr = CheckpointManager(str(tmp_path / 'local'),
+                                async_save=False, replication=False)
+        big = {'w': onp.random.RandomState(0)
+               .randn(512, 512).astype(onp.float32)}
+        mgr.save(1, params=big, block=True)
+        doc = mf.read_manifest(mgr.step_dir(1))
+        rels = [e['file'] for e in doc['arrays'] + doc['blobs']]
+
+        # start a bandwidth-paced put (1 MB/s over ~1 MB) and SIGKILL
+        # the server mid-transfer
+        errs = []
+
+        def slow_put():
+            rel = rels[0]
+            with open(os.path.join(mgr.step_dir(1), rel), 'rb') as f:
+                data = f.read()
+            try:
+                dist.file_put('127.0.0.1', port, 'rank0', 1, rel, data,
+                              timeout=10.0, bandwidth_mbps=0.4)
+            except MXNetError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=slow_put)
+        t.start()
+        time.sleep(0.5)                      # mid-transfer
+        server.send_signal(signal.SIGKILL)
+        server.wait()
+        t.join(20.0)
+        assert errs, "the interrupted transfer did not surface an error"
+        # no partial replica visible: no committed step dir anywhere
+        nsdir = os.path.join(root, 'rank0')
+        assert mf.committed_steps(nsdir) == []
+
+        # restart over the same root: stale staging swept, and a full
+        # push + commit succeeds
+        server = spawn()
+        for rel in rels + [mf.MANIFEST_NAME]:
+            with open(os.path.join(mgr.step_dir(1), rel), 'rb') as f:
+                dist.file_put('127.0.0.1', port, 'rank0', 1, rel,
+                              f.read(), timeout=10.0)
+        dist.replica_commit('127.0.0.1', port, 'rank0', 1, timeout=10.0)
+        assert mf.committed_steps(nsdir) == [1]
+        mf.validate_step_dir(os.path.join(nsdir, mf.step_dir_name(1)))
+        assert not mf.stale_tmp_dirs(nsdir), \
+            "restart did not sweep the dead transfer's staging"
+        mgr.close()
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait()
+
+
+_SENDER_KILL9 = r"""
+import os, signal, sys
+sys.path.insert(0, os.getcwd())
+import numpy as onp
+from mxnet_tpu.checkpoint import CheckpointManager, ReplicaManager
+root, hole_port = sys.argv[1], int(sys.argv[2])
+mgr = CheckpointManager(root, async_save=False, replication=False)
+# replication target is a black-hole: the push is still PENDING when
+# the kill lands — exactly "between local commit and replication"
+rm = ReplicaManager(mgr, rank=0, peers=[(1, '127.0.0.1', hole_port)],
+                    port=0, scrub_seconds=0, resync=False, timeout=30.0)
+mgr.attach_replication(rm)
+params = {'w': onp.arange(12, dtype=onp.float32).reshape(3, 4)}
+mgr.save(1, params=params, block=True)
+assert os.path.isdir(os.path.join(root, 'step_0000000001'))
+print('COMMITTED', flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+print('UNREACHABLE')
+"""
+
+
+def test_sender_kill9_after_commit_resumes_replication_on_restart(
+        tmp_path):
+    """Acceptance: kill -9 the SENDER between local commit and
+    replication — the local restore is unaffected, and a restarted
+    manager's resync pass pushes the missing step to the peer."""
+    root = str(tmp_path / 'a')
+    hole = socket.socket()
+    hole.bind(('127.0.0.1', 0))
+    hole.listen(0)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    try:
+        res = subprocess.run(
+            [sys.executable, '-c', _SENDER_KILL9, root,
+             str(hole.getsockname()[1])],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=600)
+        assert res.returncode == -signal.SIGKILL, (res.returncode,
+                                                   res.stderr)
+        assert 'COMMITTED' in res.stdout
+        assert 'UNREACHABLE' not in res.stdout
+    finally:
+        hole.close()
+
+    # local restore unaffected
+    mgr_a = CheckpointManager(root, replication=False)
+    ck = mgr_a.restore_latest(apply=False)
+    assert ck.step == 1
+    onp.testing.assert_array_equal(
+        ck.params['w'], onp.arange(12, dtype=onp.float32).reshape(3, 4))
+
+    # "restart": a live peer + a fresh ReplicaManager with resync=True
+    # pushes the committed-but-never-replicated step
+    mgr_b = CheckpointManager(str(tmp_path / 'b'), async_save=False,
+                              replication=False)
+    rm_b = ReplicaManager(mgr_b, rank=1, peers=[], port=0,
+                          scrub_seconds=0, resync=False)
+    mgr_b.attach_replication(rm_b)
+    rm_a = ReplicaManager(
+        mgr_a, rank=0, peers=[(1, '127.0.0.1', rm_b.server.port)],
+        port=0, scrub_seconds=0, resync=True)
+    mgr_a.attach_replication(rm_a)
+    try:
+        assert rm_a.wait(30)
+        assert mf.committed_steps(_hosted_dir(mgr_b)) == [1]
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog verdict / auto wiring / CLI
+# ---------------------------------------------------------------------------
+
+def test_stall_verdict_peer_loss_suspected_during_replica_fetch():
+    from mxnet_tpu.checkpoint import replica as replica_mod
+
+    class _Ms:
+        rank = 0
+        deadline_seconds = 1.0
+
+        def lost_peers(self):
+            return []
+
+        def peer_ages(self):
+            return {1: 0.1}
+
+    # all peers beating, no fetch: local stall
+    v = stall_verdict(_Ms())
+    assert v['verdict'] == 'local_stall' and 'during' not in v
+    with replica_mod._fetching():
+        # a fetch in flight flips the verdict: the serving peer is the
+        # prime suspect even though it still heartbeats
+        v = stall_verdict(_Ms())
+        assert v['verdict'] == 'peer_loss_suspected'
+        assert v['during'] == 'replica_fetch'
+        # ...even with no membership at all
+        v = stall_verdict(None) if dist.membership() is None else None
+        if v is not None:
+            assert v['verdict'] == 'peer_loss_suspected'
+            assert v['during'] == 'replica_fetch'
+    assert stall_verdict(None) is None or dist.membership() is not None
+
+
+def test_watchdog_report_names_replica_fetch(tmp_path):
+    from mxnet_tpu.resilience.watchdog import StepWatchdog
+    from mxnet_tpu.checkpoint import replica as replica_mod
+
+    class _Ms:
+        rank = 0
+        deadline_seconds = 1.0
+
+        def lost_peers(self):
+            return []
+
+        def peer_ages(self):
+            return {1: 0.1}
+
+    wd = StepWatchdog(deadline_seconds=60, membership=_Ms())
+    with replica_mod._fetching():
+        report = wd._format_report(61.0, 7)
+    assert 'PEER LOSS SUSPECTED (during replica fetch)' in report
+    report = wd._format_report(61.0, 7)
+    assert 'LOCAL STALL' in report
+
+
+def test_manager_auto_attaches_replication_from_membership(
+        tmp_path, monkeypatch):
+    """The production wiring: MXTPU_CHECKPOINT_REPLICAS > 0 + a running
+    membership world > 1 auto-attaches a ReplicaManager serving on
+    MXTPU_REPLICA_PORT_BASE + rank."""
+    from mxnet_tpu.resilience.drill import _free_port_base
+    base = _free_port_base(1)
+    monkeypatch.setenv('MXTPU_REPLICA_PORT_BASE', str(base))
+    monkeypatch.setenv('MXTPU_CHECKPOINT_REPLICAS', '1')
+    ms = dist.Membership(0, 2, port=_free_port_base(1),
+                         heartbeat_seconds=0.05, deadline_seconds=5.0)
+    monkeypatch.setattr(dist, '_membership', ms)
+    try:
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.replica is not None
+        assert mgr.replica.rank == 0 and mgr.replica.ns == 'rank0'
+        assert mgr.replica.server.port == base
+        mgr.close()
+        assert mgr.replica is None
+        # replication=False forces it off even with the env set
+        mgr2 = CheckpointManager(str(tmp_path), replication=False)
+        assert mgr2.replica is None
+        mgr2.close()
+    finally:
+        ms.stop()
+
+
+def test_manifest_cli_scrub_exit_codes(tmp_path):
+    """tools/check_checkpoint_manifest.py --scrub deep-verifies local
+    steps AND hosted replicas with distinct exit codes: 0 clean, 2
+    corrupt, 3 missing."""
+    mgr_a, mgr_b, rm_a, rm_b = _pair(tmp_path)
+    tool = os.path.join(REPO, 'tools', 'check_checkpoint_manifest.py')
+
+    def run(path):
+        return subprocess.run(
+            [sys.executable, tool, path, '--scrub'],
+            capture_output=True, text=True).returncode
+
+    try:
+        # a wiped/empty root must NOT pass the deep scan as clean
+        empty = str(tmp_path / 'wiped')
+        os.makedirs(empty)
+        assert run(empty) == 3
+        mgr_a.save(1, params=PARAMS, block=True)
+        mgr_a.save(2, params=PARAMS, block=True)
+        assert rm_a.wait(20)
+        assert run(mgr_a.directory) == 0
+        assert run(mgr_b.directory) == 0      # hosted replicas scanned
+        # corrupt: hash mismatch in a HOSTED replica -> 2
+        _flip_byte(os.path.join(_hosted_dir(mgr_b), mf.step_dir_name(1),
+                                'arrays', 'a00000.nd'))
+        assert run(mgr_b.directory) == 2
+        # missing payload file -> 3
+        os.unlink(_payload_file(mgr_a, 2))
+        assert run(mgr_a.directory) == 3
+        # corrupt dominates a mixed tree -> 2
+        _flip_byte(_payload_file(mgr_a, 1))
+        assert run(mgr_a.directory) == 2
+    finally:
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# the e2e disk-loss drill
+# ---------------------------------------------------------------------------
+
+def test_disk_loss_drill_survivor_restores_from_replica(tmp_path):
+    """Acceptance: two-worker drill with the checkpoint OWNER's
+    directory wiped before its SIGKILL — the survivor restores from the
+    replica it hosts (run_drill asserts the source and that the fetched
+    step is bit-identical to the hosted copy) and its post-re-form
+    trajectory is bit-identical to a clean local restore."""
+    from mxnet_tpu.resilience.drill import run_drill
+    result = run_drill(str(tmp_path), disk_loss=True)
+    assert result['ok'] and result['bit_identical']
+    assert result['restore_source'].startswith('hosted:rank1')
+    assert result['post_steps'] >= 1
+    assert 0 < result['mttr']['detect_seconds'] < 10
+    assert result['mttr']['total_seconds'] < 20

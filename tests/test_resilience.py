@@ -42,8 +42,8 @@ def _clean_faults_and_telemetry():
 def test_fault_sites_registered_and_unknown_site_raises():
     s = faults.sites()
     for name in ('io.decode', 'io.device_put', 'dataloader.worker',
-                 'step.dispatch', 'checkpoint.write',
-                 'collective.all_reduce'):
+                 'step.dispatch', 'checkpoint.write', 'checkpoint.read',
+                 'collective.all_reduce', 'dist.file_put'):
         assert name in s
     with pytest.raises(MXNetError, match='unknown fault site'):
         faults.arm('io.decoed', 'raise')          # typo fails loudly

@@ -4,6 +4,7 @@
 Usage::
 
     python tools/check_checkpoint_manifest.py CKPT_DIR [--step N] [--latest]
+    python tools/check_checkpoint_manifest.py CKPT_DIR --scrub
 
 ``CKPT_DIR`` is either a checkpoint root (holding ``step_*`` dirs — every
 committed step is validated, or just one with ``--step``/``--latest``) or
@@ -12,10 +13,25 @@ file is re-hashed against the manifest's sha256 and byte counts; stale
 ``*.tmp-*`` dirs are reported (informational — they are crash leftovers
 the next CheckpointManager sweeps, never valid restore targets).
 
-Exit code 0 when every validated step is intact, 1 otherwise. Runs
-standalone: loads ``mxnet_tpu/checkpoint/manifest.py`` by file path, so
-no framework (or jax) import is needed — usable on a storage host.
-Wired into the tier-1 pass via tests/test_checkpoint.py.
+``--scrub`` is the CI / storage-host deep-verification mode: every
+committed step AND every peer replica hosted under ``.replicas/<ns>/``
+is re-hashed, quarantined copies are reported, and the exit code
+distinguishes what a supervisor should do next:
+
+- **0** — every scanned step is clean;
+- **2** — at least one step is CORRUPT (hash/size/manifest mismatch —
+  the bytes are there but wrong: quarantine + repair from a replica);
+- **3** — files are MISSING but nothing is corrupt (a payload file
+  named by a manifest is absent — re-fetch from a replica; also the
+  verdict for a root with NOTHING to scan: a wiped checkpoint dir must
+  never pass the deep scan as clean);
+- **1** — argument/usage errors (also the non-scrub failure code,
+  unchanged).
+
+Runs standalone: loads ``mxnet_tpu/checkpoint/manifest.py`` by file
+path, so no framework (or jax) import is needed — usable on a storage
+host. Wired into the tier-1 pass via tests/test_checkpoint.py and
+tests/test_replica.py.
 """
 from __future__ import annotations
 
@@ -35,6 +51,28 @@ def _load_manifest_module():
     return mod
 
 
+EXIT_CLEAN = 0
+EXIT_USAGE = 1        # also the legacy (non --scrub) failure code
+EXIT_CORRUPT = 2
+EXIT_MISSING = 3
+
+
+def _scan_one(mf, t, kinds):
+    """Scan one step dir, print its verdict, record problem kinds."""
+    doc, problems = mf.scan_step_dir(t)
+    if problems:
+        for kind, detail in problems:
+            print(f"FAIL {t}: [{kind}] {detail}", file=sys.stderr)
+            kinds.add(kind)
+        return False
+    n_arr = len(doc.get('arrays', []))
+    n_blob = len(doc.get('blobs', []))
+    print(f"OK   {t}: step {doc.get('step')}, {n_arr} arrays, "
+          f"{n_blob} blobs, {doc.get('total_bytes', '?')} bytes, "
+          f"all sha256 verified")
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description='Validate checkpoint manifests/hashes.')
@@ -43,13 +81,17 @@ def main(argv=None):
                     help='validate only this step')
     ap.add_argument('--latest', action='store_true',
                     help='validate only the newest committed step')
+    ap.add_argument('--scrub', action='store_true',
+                    help='deep-verify every committed step AND every '
+                         'hosted peer replica; exit 0 clean / 2 corrupt '
+                         '/ 3 missing files')
     args = ap.parse_args(argv)
     mf = _load_manifest_module()
 
     path = os.path.abspath(args.path)
     if not os.path.isdir(path):
         print(f"{path}: not a directory", file=sys.stderr)
-        return 1
+        return EXIT_USAGE
 
     if os.path.isfile(os.path.join(path, mf.MANIFEST_NAME)):
         targets = [path]
@@ -59,17 +101,17 @@ def main(argv=None):
             if args.step not in steps:
                 print(f"{path}: no committed step {args.step} "
                       f"(have {steps})", file=sys.stderr)
-                return 1
+                return EXIT_USAGE
             steps = [args.step]
         elif args.latest:
             if not steps:
                 print(f"{path}: no committed steps", file=sys.stderr)
-                return 1
+                return EXIT_USAGE
             steps = steps[-1:]
-        elif not steps:
+        elif not steps and not args.scrub:
             print(f"{path}: no committed steps and no "
                   f"{mf.MANIFEST_NAME}", file=sys.stderr)
-            return 1
+            return EXIT_USAGE
         targets = [os.path.join(path, mf.step_dir_name(s)) for s in steps]
         for tmp in mf.stale_tmp_dirs(path):
             print(f"note: stale uncommitted write {tmp} (crash leftover; "
@@ -79,21 +121,42 @@ def main(argv=None):
                 'manager rolls it back' if not os.path.isdir(final) \
                 else 'superseded copy, swept by the next manager'
             print(f"note: retired re-save copy {old} ({state})")
+        for q, qstep in mf.quarantined_dirs(path):
+            print(f"note: quarantined copy {q} (step {qstep} failed a "
+                  f"scrub/restore re-hash; evidence, never a restore "
+                  f"target, expires with retention)")
+        if args.scrub:
+            # hosted peer replicas ride the same deep verification:
+            # a replica this host cannot vouch for is not survivability
+            for ns in mf.replica_namespaces(path):
+                nsdir = os.path.join(path, mf.REPLICA_SUBDIR, ns)
+                for s in mf.committed_steps(nsdir):
+                    targets.append(os.path.join(nsdir,
+                                                mf.step_dir_name(s)))
 
-    failures = 0
+    kinds = set()
+    ok = 0
     for t in targets:
-        try:
-            doc = mf.validate_step_dir(t)
-        except Exception as e:  # noqa: BLE001 - report and keep scanning
-            print(f"FAIL {t}: {e}", file=sys.stderr)
-            failures += 1
-            continue
-        n_arr = len(doc.get('arrays', []))
-        n_blob = len(doc.get('blobs', []))
-        print(f"OK   {t}: step {doc.get('step')}, {n_arr} arrays, "
-              f"{n_blob} blobs, {doc.get('total_bytes', '?')} bytes, "
-              f"all sha256 verified")
-    return 1 if failures else 0
+        if _scan_one(mf, t, kinds):
+            ok += 1
+    if args.scrub:
+        if not targets:
+            # "nothing to scan" is NOT clean: a wiped checkpoint root
+            # (the very disk-loss event this scan defends against)
+            # must not pass the CI deep scan — report it as missing
+            print(f"scrub: {path} holds no committed steps and no "
+                  f"hosted replicas — nothing to vouch for",
+                  file=sys.stderr)
+            return EXIT_MISSING
+        print(f"scrub: {ok}/{len(targets)} step dirs clean "
+              f"({len(targets) - ok} with problems: "
+              f"{sorted(kinds) or 'none'})")
+        if 'corrupt' in kinds:
+            return EXIT_CORRUPT
+        if 'missing' in kinds:
+            return EXIT_MISSING
+        return EXIT_CLEAN
+    return EXIT_USAGE if kinds else EXIT_CLEAN
 
 
 if __name__ == '__main__':

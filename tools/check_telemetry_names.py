@@ -133,6 +133,26 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_checkpoint_saves_total': 'counter',
         'mxnet_tpu_checkpoint_gc_total': 'counter',
         'mxnet_tpu_checkpoint_corrupt_total': 'counter',
+        # survivability layer (ISSUE 10): peer replication of committed
+        # steps over the membership side channel — successful pushes /
+        # wire bytes / bounded-retry-exhausted failures (by peer rank),
+        # local-commit-to-replica-commit lag, any-replica restore
+        # fetches, and replica retirements (retention GC on the owner,
+        # replica_delete on the receiver, orphan GC on a scrub pass)
+        'mxnet_tpu_checkpoint_replica_pushes_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_bytes_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_failures_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_lag_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_replica_fetches_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_gc_total': 'counter',
+        # background integrity scrubber: passes completed, committed
+        # steps (local or hosted) that failed their re-hash and were
+        # quarantined, steps repaired bit-identical from a healthy
+        # replica, and the wall cost of one pass
+        'mxnet_tpu_checkpoint_scrub_passes_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_corrupt_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_repaired_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_seconds': 'histogram',
     },
 }
 
