@@ -102,7 +102,8 @@ def get_lib():
             return _lib
         _lib_tried = True
         if not os.path.isfile(_LIB_PATH):
-            if os.environ.get('MXNET_TPU_NO_NATIVE_BUILD'):
+            from . import config as _config
+            if _config.get('MXNET_TPU_NO_NATIVE_BUILD'):
                 return None
             if not _try_build():
                 return None

@@ -157,6 +157,42 @@ register('MXNET_SUBGRAPH_BACKEND', str, '',
          'call does not name one (see mxnet_tpu.subgraph).')
 register('MXNET_SEED', int, 0,
          'Process-wide RNG seed applied at import when set.')
+register('MXNET_TPU_COORDINATOR', str, '',
+         'host:port of process 0 for multi-process init '
+         '(parallel.dist.init / start_membership). Empty: fall back to '
+         'the DMLC_PS_ROOT_URI/_PORT drop-in names, then '
+         'localhost:12345 with a warning.')
+register('MXNET_TPU_NUM_PROCS', int, 0,
+         'Total process count for multi-process init. 0 (default): '
+         'fall back to DMLC_NUM_WORKER, then single-process.')
+register('MXNET_TPU_PROC_ID', int, -1,
+         "This process's rank for multi-process init. -1 (default): "
+         'fall back to DMLC_WORKER_ID, then 0.')
+register('MXNET_TPU_IO_TRANSPORT', str, 'u8',
+         "ImageRecordIter host->device transport: 'u8' moves raw uint8 "
+         'NHWC and normalizes on device in one cached jitted program '
+         "(~4x fewer host bytes); 'f32' materializes normalized "
+         'float32 on the host (legacy path).')
+register('MXNET_TPU_IO_DECODE_CACHE_MB', float, 256.0,
+         'Byte budget (MB) of the cross-epoch decode cache: decoded + '
+         'short-side-resized images reused across epochs (crop/mirror/'
+         'normalize stay per-epoch). 0 disables the cache.')
+register('MXNET_TPU_FUSED_DEBUG', _bool, False,
+         "Print the traceback when an optimizer's update() fails to "
+         'trace into the fused jitted update (the Trainer then falls '
+         'back to the eager per-parameter loop with a warning).')
+register('MXTPU_PALLAS_LN', _bool, False,
+         'Route the transformer residual+LN epilogue through the fused '
+         'Pallas kernel (ops/pallas_layernorm.py) when a TPU is '
+         'present and the hidden dim is a multiple of 128. Default: '
+         'the XLA path (flag-gated until measured on-chip).')
+register('MXNET_TPU_MNIST_DIR', str, '',
+         'Directory holding the MNIST idx files for '
+         'test_utils.get_mnist(). Empty: a deterministic synthetic '
+         'set (zero-egress environments cannot download).')
+register('MXNET_TPU_NO_NATIVE_BUILD', _bool, False,
+         'Never compile the native IO library on demand: missing '
+         'prebuilt .so means the pure-Python pipeline fallback.')
 register('MXNET_TPU_TELEMETRY', _bool, False,
          'Enable the runtime telemetry registry (mxnet_tpu.telemetry): '
          'op-dispatch/compile/kvstore/IO/step metrics with Prometheus, '

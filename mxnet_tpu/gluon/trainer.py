@@ -777,8 +777,8 @@ class Trainer:
                         'trainer:fused_update', repr(sig),
                         _time.perf_counter() - t0)
             except Exception:
-                import os
-                if os.environ.get('MXNET_TPU_FUSED_DEBUG'):
+                from .. import config as _config
+                if _config.get('MXNET_TPU_FUSED_DEBUG'):
                     import traceback
                     traceback.print_exc()
                 import warnings

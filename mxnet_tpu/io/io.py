@@ -591,7 +591,8 @@ class ImageRecordIter(DataIter):
         self.std = onp.array([std_r, std_g, std_b], onp.float32).reshape(3, 1, 1)
         self.resize = resize
         if transport is None:
-            transport = os.environ.get('MXNET_TPU_IO_TRANSPORT', 'u8')
+            from .. import config as _config
+            transport = _config.get('MXNET_TPU_IO_TRANSPORT')
         if transport not in ('u8', 'f32'):
             raise MXNetError(f"transport must be 'u8' or 'f32', "
                              f"got {transport!r}")
@@ -603,8 +604,9 @@ class ImageRecordIter(DataIter):
         self.transport = transport
         self.dtype = dtype
         if decode_cache_mb is None:
-            decode_cache_mb = float(os.environ.get(
-                'MXNET_TPU_IO_DECODE_CACHE_MB', '256'))
+            from .. import config as _config
+            decode_cache_mb = float(
+                _config.get('MXNET_TPU_IO_DECODE_CACHE_MB'))
         self.decode_cache_mb = decode_cache_mb
         if corrupt_policy is None:
             from .. import config as _config

@@ -311,8 +311,8 @@ def add_layer_norm(x, res, gamma, beta, eps=1e-5):
     layer. Routes to the fused Pallas kernel (ops/pallas_layernorm.py)
     when MXTPU_PALLAS_LN=1 and a TPU is present; default is the XLA
     path (flag-gated until measured on-chip, like the attention knobs)."""
-    import os
-    if os.environ.get('MXTPU_PALLAS_LN') == '1':
+    from .. import config as _config
+    if _config.get('MXTPU_PALLAS_LN'):
         from .pallas_layernorm import fused_add_layer_norm, \
             pallas_available
         if pallas_available() and x.shape[-1] % 128 == 0:

@@ -54,14 +54,17 @@ def _resolve_world(coordinator=None, num_processes=None, process_id=None,
     drop-in names next. The coordinator (and with it the
     localhost-fallback warning) is only resolved when actually needed —
     a single-process init has nobody to rendezvous with."""
-    num_processes = num_processes or int(os.environ.get(
-        'MXNET_TPU_NUM_PROCS', os.environ.get('DMLC_NUM_WORKER', '1')))
-    process_id = process_id if process_id is not None else int(
-        os.environ.get('MXNET_TPU_PROC_ID',
-                       os.environ.get('DMLC_WORKER_ID', '0')))
+    from .. import config as _config
+    num_processes = num_processes \
+        or _config.get('MXNET_TPU_NUM_PROCS') \
+        or int(os.environ.get('DMLC_NUM_WORKER', '1'))
+    if process_id is None:
+        pid = _config.get('MXNET_TPU_PROC_ID')
+        process_id = pid if pid >= 0 \
+            else int(os.environ.get('DMLC_WORKER_ID', '0'))
     if need_coordinator:
         coordinator = coordinator \
-            or os.environ.get('MXNET_TPU_COORDINATOR') \
+            or _config.get('MXNET_TPU_COORDINATOR') \
             or _dmlc_coordinator()
     return coordinator, int(num_processes), int(process_id)
 
@@ -292,7 +295,7 @@ def _elastic_port(coordinator=None):
     if port:
         return int(port)
     base = 12345
-    coordinator = coordinator or os.environ.get('MXNET_TPU_COORDINATOR')
+    coordinator = coordinator or _config.get('MXNET_TPU_COORDINATOR')
     if coordinator and ':' in coordinator:
         try:
             base = int(coordinator.rsplit(':', 1)[1])
@@ -336,7 +339,12 @@ class Membership:
             else _config.get('MXTPU_PEER_DEADLINE_SECONDS'))
         self.is_coordinator = self.rank == 0
         self.current_step = None      # piggybacked on each beat
-        self._lock = threading.Lock()
+        # RLock: view()/lost_peers() are reachable from the checkpoint
+        # SIGTERM handler (save() records the membership world in the
+        # manifest) — a signal landing while THIS thread holds a plain
+        # Lock would self-deadlock the preemption save. Critical
+        # sections are tiny and never block, so reentrancy is safe.
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads = []
         self._server = None

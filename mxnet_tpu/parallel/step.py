@@ -87,8 +87,9 @@ def _put_replicated(x, sharding):
     broadcast collective is exactly what a dead peer would wedge."""
     if _sharding_spans_processes(sharding):
         from jax.experimental import multihost_utils
+        # lint: host-sync-ok param (re)placement runs at build/restore/re-form, not per step
         x = multihost_utils.broadcast_one_to_all(onp.asarray(x))
-        x = onp.asarray(x)
+        x = onp.asarray(x)  # lint: host-sync-ok cold path, see above
     return jax.device_put(x, sharding)
 
 
@@ -99,6 +100,7 @@ def _put_batch(x, sharding):
     the global batch is their concatenation over the dp axis."""
     if _sharding_spans_processes(sharding):
         return jax.make_array_from_process_local_data(
+            # lint: host-sync-ok the batch arrives host-resident from the io pipeline; h2d staging
             sharding, onp.asarray(x))
     return jax.device_put(x, sharding)
 
@@ -929,6 +931,7 @@ class ShardedTrainStep:
         """Host-side fp32 master for param ``n`` in its PERSISTENT
         layout: logical shape, or flattened + zero-padded to the dp
         multiple for ZeRO-3 flat params."""
+        # lint: host-sync-ok master seeding runs once at build/restore, not in the step loop
         a = onp.asarray(arr, onp.float32)
         fz = getattr(self, '_flat_meta', {}).get(n)
         if fz is not None:
@@ -948,7 +951,7 @@ class ShardedTrainStep:
         """Flatten+pad a logical-shape restored master/moment into this
         step's ZeRO-3 flat layout (identity elsewhere, and for the
         shape-() step counters)."""
-        a = onp.asarray(a)
+        a = onp.asarray(a)  # lint: host-sync-ok checkpoint-restore path, not the step loop
         fz = getattr(self, '_flat_meta', {}).get(n)
         if fz is not None and a.shape == self._shapes[n]:
             a = onp.pad(a.reshape(-1).astype(onp.float32, copy=False),
@@ -1060,6 +1063,7 @@ class ShardedTrainStep:
             if n in self._flat_meta and n not in restored_master \
                     and n in self._master_names:
                 self._master[n] = _put_replicated(
+                    # lint: host-sync-ok restore-time reseed, runs once per restore
                     self._master_host(n, onp.asarray(p.data()._data)),
                     self._master_shardings[n])
         self._step_count = int(doc.get('step_count', self._step_count))

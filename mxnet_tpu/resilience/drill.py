@@ -119,7 +119,8 @@ def _worker(args):
     from mxnet_tpu.parallel import dist, make_mesh
     from mxnet_tpu.resilience import ElasticController
 
-    rank = int(os.environ.get('MXNET_TPU_PROC_ID', '0'))
+    from .. import config as _config
+    rank = max(0, _config.get('MXNET_TPU_PROC_ID'))
     progress = os.path.join(args.workdir, f'progress-rank{rank}.txt')
     dist.init()
     ms = dist.start_membership(port=args.port,

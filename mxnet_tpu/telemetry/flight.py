@@ -110,6 +110,7 @@ class FlightRecorder:
             return
         rec, loss = pending
         try:
+            # lint: host-sync-ok deliberately deferred ONE step: this program finished long ago
             rec['loss'] = float(getattr(loss, '_data', loss))
         except Exception:
             rec['loss'] = None
@@ -249,7 +250,11 @@ class FlightRecorder:
 
 
 _recorder = None
-_recorder_lock = threading.Lock()
+# RLock: get() runs inside the fatal-signal dump hooks — a signal
+# interrupting the first-construction critical section on this very
+# thread must re-enter, not self-deadlock (the PR-8 SIGTERM bug class;
+# now enforced by tools/mxtpu_lint's signal-safety rule)
+_recorder_lock = threading.RLock()
 _hooks = {'atexit': False, 'signals': False}
 
 

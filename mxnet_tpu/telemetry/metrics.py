@@ -71,7 +71,12 @@ class Metric:
                 f"and namespaced mxnet_tpu_*")
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        # RLock: instrumented paths (checkpoint save gauges/histograms)
+        # run inside the SIGTERM preemption save — a signal landing
+        # while this thread is mid-inc() must re-enter, not deadlock.
+        # A reentrant update can at worst lose one increment; a plain
+        # Lock loses the whole preemption grace window.
+        self._lock = threading.RLock()
         self._values: Dict[Tuple, Any] = {}
 
     def labelsets(self):

@@ -58,8 +58,12 @@ __all__ = [
 _state = {'on': False}
 _DEFAULT_RING = None          # resolved lazily from MXTPU_TRACE_RING
 
-# thread registry: ring creation (rare) locks; appends never do
-_rings_lock = threading.Lock()
+# thread registry: ring creation (rare) locks; appends never do.
+# RLock: span() runs inside the SIGTERM preemption save (checkpoint
+# spans) — a signal interrupting THIS thread mid-registration must
+# re-enter the registry, not self-deadlock on a plain Lock (the PR-8
+# bug class; enforced by tools/mxtpu_lint's signal-safety rule).
+_rings_lock = threading.RLock()
 _rings = []                   # every _Ring ever created, in tid order
 _tids = {}                    # thread ident -> (tid, name)
 _local = threading.local()
@@ -176,13 +180,13 @@ def _now_us() -> float:
 
 @contextlib.contextmanager
 def _rings_locked(timeout=2.0):
-    """Best-effort lock for the read/export paths. Crash-time dumps can
-    run inside a fatal-signal handler that interrupted THIS thread while
-    it held _rings_lock (every step's drain takes it briefly) — a plain
-    acquire would self-deadlock. After `timeout` we proceed lock-free:
-    the holder that timed us out is interrupted or blocked, not
-    mutating. Writers (_ring, tid assignment, clear) keep blocking
-    acquires."""
+    """Best-effort lock for the read/export paths. Same-thread signal
+    re-entry is already safe (the registry lock is reentrant), but a
+    crash-time dump must also survive a wedged holder on ANOTHER
+    thread: after `timeout` we proceed lock-free — the holder that
+    timed us out is interrupted or blocked, not mutating. Writers
+    (_ring, tid assignment, clear) keep blocking acquires; their
+    critical sections never block."""
     got = _rings_lock.acquire(timeout=timeout)
     try:
         yield

@@ -822,7 +822,8 @@ def get_mnist(path=None):
     MXNET_TPU_MNIST_DIR); falls back to a deterministic synthetic set in
     airgapped environments (ref: test_utils.py get_mnist, which
     downloads — zero-egress images can't)."""
-    path = path or os.environ.get('MXNET_TPU_MNIST_DIR')
+    from . import config as _tu_config
+    path = path or _tu_config.get('MXNET_TPU_MNIST_DIR')
     if path and os.path.exists(os.path.join(path,
                                             'train-images-idx3-ubyte')):
         def read_idx(p):  # pragma: no cover - needs real files

@@ -28,49 +28,27 @@ distinguishes what a supervisor should do next:
 - **1** — argument/usage errors (also the non-scrub failure code,
   unchanged).
 
-Runs standalone: loads ``mxnet_tpu/checkpoint/manifest.py`` by file
-path, so no framework (or jax) import is needed — usable on a storage
-host. Wired into the tier-1 pass via tests/test_checkpoint.py and
-tests/test_replica.py.
+Thin wrapper: target collection, per-step verification and the exit
+ladder live in ``tools/mxtpu_lint/artifacts.py`` (shared with the lint
+framework). Still standalone — the manifest module loads by file path,
+so no framework (or jax) import is needed on a storage host.
 """
 from __future__ import annotations
 
 import argparse
-import importlib.util
 import os
 import sys
 
+try:
+    from mxtpu_lint import artifacts as _artifacts
+except ImportError:                      # run from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxtpu_lint import artifacts as _artifacts
 
-def _load_manifest_module():
-    here = os.path.dirname(os.path.abspath(__file__))
-    path = os.path.join(os.path.dirname(here), 'mxnet_tpu', 'checkpoint',
-                        'manifest.py')
-    spec = importlib.util.spec_from_file_location('_ckpt_manifest', path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-EXIT_CLEAN = 0
-EXIT_USAGE = 1        # also the legacy (non --scrub) failure code
-EXIT_CORRUPT = 2
-EXIT_MISSING = 3
-
-
-def _scan_one(mf, t, kinds):
-    """Scan one step dir, print its verdict, record problem kinds."""
-    doc, problems = mf.scan_step_dir(t)
-    if problems:
-        for kind, detail in problems:
-            print(f"FAIL {t}: [{kind}] {detail}", file=sys.stderr)
-            kinds.add(kind)
-        return False
-    n_arr = len(doc.get('arrays', []))
-    n_blob = len(doc.get('blobs', []))
-    print(f"OK   {t}: step {doc.get('step')}, {n_arr} arrays, "
-          f"{n_blob} blobs, {doc.get('total_bytes', '?')} bytes, "
-          f"all sha256 verified")
-    return True
+EXIT_CLEAN = _artifacts.EXIT_CLEAN
+EXIT_USAGE = _artifacts.EXIT_USAGE
+EXIT_CORRUPT = _artifacts.EXIT_CORRUPT
+EXIT_MISSING = _artifacts.EXIT_MISSING
 
 
 def main(argv=None):
@@ -86,76 +64,41 @@ def main(argv=None):
                          'hosted peer replica; exit 0 clean / 2 corrupt '
                          '/ 3 missing files')
     args = ap.parse_args(argv)
-    mf = _load_manifest_module()
+    mf = _artifacts.load_manifest_module()
 
     path = os.path.abspath(args.path)
     if not os.path.isdir(path):
         print(f"{path}: not a directory", file=sys.stderr)
         return EXIT_USAGE
 
-    if os.path.isfile(os.path.join(path, mf.MANIFEST_NAME)):
-        targets = [path]
-    else:
-        steps = mf.committed_steps(path)
-        if args.step is not None:
-            if args.step not in steps:
-                print(f"{path}: no committed step {args.step} "
-                      f"(have {steps})", file=sys.stderr)
-                return EXIT_USAGE
-            steps = [args.step]
-        elif args.latest:
-            if not steps:
-                print(f"{path}: no committed steps", file=sys.stderr)
-                return EXIT_USAGE
-            steps = steps[-1:]
-        elif not steps and not args.scrub:
-            print(f"{path}: no committed steps and no "
-                  f"{mf.MANIFEST_NAME}", file=sys.stderr)
-            return EXIT_USAGE
-        targets = [os.path.join(path, mf.step_dir_name(s)) for s in steps]
-        for tmp in mf.stale_tmp_dirs(path):
-            print(f"note: stale uncommitted write {tmp} (crash leftover; "
-                  f"ignored by restore, swept by the next manager)")
-        for old, final in mf.stale_old_dirs(path):
-            state = 'recovery source — final copy missing, the next ' \
-                'manager rolls it back' if not os.path.isdir(final) \
-                else 'superseded copy, swept by the next manager'
-            print(f"note: retired re-save copy {old} ({state})")
-        for q, qstep in mf.quarantined_dirs(path):
-            print(f"note: quarantined copy {q} (step {qstep} failed a "
-                  f"scrub/restore re-hash; evidence, never a restore "
-                  f"target, expires with retention)")
-        if args.scrub:
-            # hosted peer replicas ride the same deep verification:
-            # a replica this host cannot vouch for is not survivability
-            for ns in mf.replica_namespaces(path):
-                nsdir = os.path.join(path, mf.REPLICA_SUBDIR, ns)
-                for s in mf.committed_steps(nsdir):
-                    targets.append(os.path.join(nsdir,
-                                                mf.step_dir_name(s)))
+    targets, notes, usage_error = _artifacts.collect_targets(
+        mf, path, step=args.step, latest=args.latest, scrub=args.scrub)
+    if usage_error:
+        print(usage_error, file=sys.stderr)
+        return EXIT_USAGE
+    for note in notes:
+        print(note)
 
     kinds = set()
     ok = 0
     for t in targets:
-        if _scan_one(mf, t, kinds):
+        good, line, failures = _artifacts.scan_step_dir(mf, t)
+        if good:
             ok += 1
+            print(line)
+        for kind, fline in failures:
+            print(fline, file=sys.stderr)
+            kinds.add(kind)
     if args.scrub:
         if not targets:
-            # "nothing to scan" is NOT clean: a wiped checkpoint root
-            # (the very disk-loss event this scan defends against)
-            # must not pass the CI deep scan — report it as missing
             print(f"scrub: {path} holds no committed steps and no "
                   f"hosted replicas — nothing to vouch for",
                   file=sys.stderr)
-            return EXIT_MISSING
-        print(f"scrub: {ok}/{len(targets)} step dirs clean "
-              f"({len(targets) - ok} with problems: "
-              f"{sorted(kinds) or 'none'})")
-        if 'corrupt' in kinds:
-            return EXIT_CORRUPT
-        if 'missing' in kinds:
-            return EXIT_MISSING
-        return EXIT_CLEAN
+        else:
+            print(f"scrub: {ok}/{len(targets)} step dirs clean "
+                  f"({len(targets) - ok} with problems: "
+                  f"{sorted(kinds) or 'none'})")
+        return _artifacts.scrub_exit_code(targets, kinds)
     return EXIT_USAGE if kinds else EXIT_CLEAN
 
 

@@ -8,7 +8,11 @@ literal) must be:
 - namespaced ``mxnet_tpu_*`` and lowercase_snake,
 - registered under exactly one metric kind (a name used both as a
   counter and, say, a histogram is a registry collision waiting to
-  happen at runtime).
+  happen at runtime),
+- consistent with the per-subsystem contract
+  (``tools/mxtpu_lint/contracts.py`` SUBSYSTEM_METRICS — the single
+  home of the name list; this CLI is a thin wrapper over the shared
+  framework's registry-drift scanner).
 
 Run from anywhere: ``python tools/check_telemetry_names.py``. Exit code 0
 when clean, 1 with one line per violation otherwise. Wired into the
@@ -17,192 +21,37 @@ tier-1 pass via tests/test_telemetry.py::test_metric_name_lint.
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-NAME_RE = re.compile(r'^mxnet_tpu_[a-z][a-z0-9_]*$')
+try:
+    from mxtpu_lint import contracts as _contracts
+    from mxtpu_lint.core import FileIndex
+    from mxtpu_lint.rules.registry_drift import scan_metrics
+except ImportError:                      # run from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxtpu_lint import contracts as _contracts
+    from mxtpu_lint.core import FileIndex
+    from mxtpu_lint.rules.registry_drift import scan_metrics
 
-# call name -> metric kind it implies (None: kind-agnostic read)
-KINDS = {
-    'inc': 'counter', 'counter': 'counter',
-    'set_gauge': 'gauge', 'gauge': 'gauge',
-    'observe': 'histogram', 'histogram': 'histogram',
-    'value': None,
-}
-
-CALL_RE = re.compile(
-    r"\b(inc|set_gauge|observe|counter|gauge|histogram|value)\(\s*"
-    r"'([^']+)'", re.S)
-
-# Subsystem contracts: metric sets that dashboards/docs (README,
-# PERF_NOTES) reference by name, with their kinds. The lint fails when
-# an instrumentation site drops/renames one of these, or adds a new
-# metric under the subsystem prefix without declaring it here — keeping
-# code, docs and dashboards from drifting apart silently.
-SUBSYSTEM_METRICS = {
-    'mxnet_tpu_io_': {
-        # batch production
-        'mxnet_tpu_io_batches_total': 'counter',
-        'mxnet_tpu_io_batch_latency_seconds': 'histogram',
-        # host-boundary traffic: bytes the python layer pulls out of the
-        # pipeline per batch (u8 transport moves ~4x less than f32)
-        'mxnet_tpu_io_host_bytes_total': 'counter',
-        # zero-copy buffer leases outstanding against the native pipeline
-        'mxnet_tpu_io_lease_depth': 'gauge',
-        # decode cache (decoded+resized images reused across epochs)
-        'mxnet_tpu_io_decode_cache_hits_total': 'counter',
-        'mxnet_tpu_io_decode_cache_misses_total': 'counter',
-        'mxnet_tpu_io_decode_cache_bytes': 'gauge',
-        # decode-prefetch health (PrefetchingIter)
-        'mxnet_tpu_io_prefetch_miss_total': 'counter',
-        'mxnet_tpu_io_prefetch_stall_seconds_total': 'counter',
-        # device prefetch: batches staged on device ahead of the
-        # consumer, and the dispatch-to-consume window each host->device
-        # copy had to overlap compute in
-        'mxnet_tpu_io_device_prefetch_depth': 'gauge',
-        'mxnet_tpu_io_h2d_overlap_seconds_total': 'counter',
-        # corrupt/truncated records silently substituted under
-        # MXNET_TPU_IO_CORRUPT_POLICY=skip (error-policy raises
-        # DataError and counts nothing)
-        'mxnet_tpu_io_corrupt_records_total': 'counter',
-    },
-    'mxnet_tpu_resilience_': {
-        # fault injection: every armed-site firing, by site + kind
-        'mxnet_tpu_resilience_faults_injected_total': 'counter',
-        # bounded retry/backoff helper (checkpoint writes, ...), by site
-        'mxnet_tpu_resilience_retries_total': 'counter',
-        # non-finite guard: bad (skipped-on-device) steps, rollbacks to
-        # the last committed checkpoint, and how long recovery took
-        'mxnet_tpu_resilience_bad_steps_total': 'counter',
-        'mxnet_tpu_resilience_rollbacks_total': 'counter',
-        'mxnet_tpu_resilience_last_rollback_step': 'gauge',
-        'mxnet_tpu_resilience_recovery_seconds': 'histogram',
-        # step watchdog stall dumps and DataLoader worker respawns
-        'mxnet_tpu_resilience_watchdog_stalls_total': 'counter',
-        'mxnet_tpu_resilience_worker_respawns_total': 'counter',
-    },
-    'mxnet_tpu_comm_': {
-        # collective traffic accounting (ZeRO / GSPMD dp path):
-        # ring-algorithm wire bytes per device by collective kind
-        # (reduce_scatter / all_gather / all_reduce / broadcast /
-        # state_scatter / param_scatter) and mesh axis. The GSPMD step
-        # counters additionally carry a `stage` label (off / zero1 /
-        # zero3) separating the ZeRO-1 writeback gather from the ZeRO-3
-        # per-layer on-use gathers: ZeRO-1 must show the SAME total
-        # bytes as the replicated update while the optimizer-state
-        # gauge drops to ~1/dp; ZeRO-3 adds the param regather wire
-        # bytes while the param gauge also drops to ~1/dp. The per-step
-        # trace instants (`comm.all_gather`) carry per-layer bytes via
-        # a `layer` arg for gather-vs-compute overlap attribution.
-        'mxnet_tpu_comm_collective_bytes_total': 'counter',
-        'mxnet_tpu_comm_collectives_total': 'counter',
-        # optimizer state (fp32 masters + moments) held by ONE device
-        'mxnet_tpu_comm_opt_state_bytes_per_device': 'gauge',
-        # persistent params (compute dtype) held by ONE device — the
-        # ZeRO-3 1/dp param residency is auditable against it
-        'mxnet_tpu_comm_param_bytes_per_device': 'gauge',
-    },
-    'mxnet_tpu_elastic_': {
-        # elastic multi-host training (membership side channel +
-        # commit/re-form/resume controller): heartbeat round-trips
-        # sent, peers declared lost past MXTPU_PEER_DEADLINE_SECONDS,
-        # completed mesh re-forms, the survivor world size after the
-        # newest re-form, and the detect->commit->teardown->restore
-        # wall time of each re-form (the MTTR the CPU drill records)
-        'mxnet_tpu_elastic_heartbeats_total': 'counter',
-        'mxnet_tpu_elastic_peer_losses_total': 'counter',
-        'mxnet_tpu_elastic_reforms_total': 'counter',
-        'mxnet_tpu_elastic_last_world_size': 'gauge',
-        'mxnet_tpu_elastic_reform_seconds': 'histogram',
-    },
-    'mxnet_tpu_trace_': {
-        # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
-        # dropped by ring overwrite, events currently buffered across
-        # every thread ring, and flight-recorder post-mortem dumps
-        'mxnet_tpu_trace_spans_total': 'counter',
-        'mxnet_tpu_trace_dropped_spans_total': 'counter',
-        'mxnet_tpu_trace_ring_depth': 'gauge',
-        'mxnet_tpu_trace_flight_dumps_total': 'counter',
-    },
-    'mxnet_tpu_checkpoint_': {
-        'mxnet_tpu_checkpoint_save_seconds': 'histogram',
-        'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
-        'mxnet_tpu_checkpoint_restore_seconds': 'histogram',
-        'mxnet_tpu_checkpoint_bytes': 'gauge',
-        'mxnet_tpu_checkpoint_last_step': 'gauge',
-        'mxnet_tpu_checkpoint_saves_total': 'counter',
-        'mxnet_tpu_checkpoint_gc_total': 'counter',
-        'mxnet_tpu_checkpoint_corrupt_total': 'counter',
-        # survivability layer (ISSUE 10): peer replication of committed
-        # steps over the membership side channel — successful pushes /
-        # wire bytes / bounded-retry-exhausted failures (by peer rank),
-        # local-commit-to-replica-commit lag, any-replica restore
-        # fetches, and replica retirements (retention GC on the owner,
-        # replica_delete on the receiver, orphan GC on a scrub pass)
-        'mxnet_tpu_checkpoint_replica_pushes_total': 'counter',
-        'mxnet_tpu_checkpoint_replica_bytes_total': 'counter',
-        'mxnet_tpu_checkpoint_replica_failures_total': 'counter',
-        'mxnet_tpu_checkpoint_replica_lag_seconds': 'histogram',
-        'mxnet_tpu_checkpoint_replica_fetches_total': 'counter',
-        'mxnet_tpu_checkpoint_replica_gc_total': 'counter',
-        # background integrity scrubber: passes completed, committed
-        # steps (local or hosted) that failed their re-hash and were
-        # quarantined, steps repaired bit-identical from a healthy
-        # replica, and the wall cost of one pass
-        'mxnet_tpu_checkpoint_scrub_passes_total': 'counter',
-        'mxnet_tpu_checkpoint_scrub_corrupt_total': 'counter',
-        'mxnet_tpu_checkpoint_scrub_repaired_total': 'counter',
-        'mxnet_tpu_checkpoint_scrub_seconds': 'histogram',
-    },
-}
+# re-exported for external callers of the original module surface
+NAME_RE = _contracts.NAME_RE
+KINDS = _contracts.KINDS
+SUBSYSTEM_METRICS = _contracts.SUBSYSTEM_METRICS
 
 
 def scan(pkg_dir):
     """{name: {kind, ...}} plus [(path, lineno, name, problem), ...]."""
-    names = {}
-    errors = []
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fname in sorted(files):
-            if not fname.endswith('.py'):
-                continue
-            path = os.path.join(root, fname)
-            with open(path) as f:
-                src = f.read()
-            for m in CALL_RE.finditer(src):
-                call, name = m.group(1), m.group(2)
-                lineno = src.count('\n', 0, m.start()) + 1
-                if not NAME_RE.match(name):
-                    errors.append(
-                        (path, lineno, name,
-                         'not lowercase_snake / not namespaced mxnet_tpu_*'))
-                    continue
-                kind = KINDS[call]
-                if kind is not None:
-                    names.setdefault(name, set()).add(kind)
-    for name, kinds in sorted(names.items()):
-        if len(kinds) > 1:
-            errors.append(
-                ('<registry>', 0, name,
-                 f"registered under multiple kinds: {sorted(kinds)}"))
-    for prefix, declared in SUBSYSTEM_METRICS.items():
-        for name, kind in sorted(declared.items()):
-            found = names.get(name)
-            if not found:
-                errors.append(
-                    ('<subsystem>', 0, name,
-                     f"declared for the {prefix}* subsystem but never "
-                     f"recorded by any instrumentation site"))
-            elif kind not in found:
-                errors.append(
-                    ('<subsystem>', 0, name,
-                     f"declared as {kind} but recorded as {sorted(found)}"))
-        for name in sorted(names):
-            if name.startswith(prefix) and name not in declared:
-                errors.append(
-                    ('<subsystem>', 0, name,
-                     f"new {prefix}* metric not declared in "
-                     f"SUBSYSTEM_METRICS (update the contract + docs)"))
-    return names, errors
+    index = FileIndex(pkg_dir)
+    names, errors = scan_metrics(index)
+    root = index.root
+    out = [
+        (p if p.startswith('<') else os.path.join(root, p), ln, n, pr)
+        for p, ln, n, pr in errors]
+    # a file the walker could not parse was not scanned — that is a
+    # coverage hole, never a clean pass
+    out += [(path, 0, '<unparsed>', f'not scanned (parse error: {err})')
+            for path, err in index.errors]
+    return names, out
 
 
 def main(argv=None):

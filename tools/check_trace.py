@@ -3,21 +3,10 @@
 
 A trace that chrome://tracing or Perfetto silently mis-renders is worse
 than no trace: a dropped 'E' makes a 2 ms span look like the rest of
-the program. This validator asserts the structural contract every dump
-in this repo promises (mxnet_tpu.telemetry.trace.balance_events
-guarantees it at export time; this tool keeps that guarantee honest):
-
-- the document is a JSON object with a ``traceEvents`` list (a bare
-  event array is accepted too — both are valid chrome-trace forms);
-  flight-recorder dumps embed their stream under the same key;
-- every event has a string ``ph``; B/E/X/i/C events carry ``name``,
-  numeric ``ts``, ``pid`` and ``tid``; X events carry numeric
-  ``dur >= 0``; M (metadata) events are exempt from ts;
-- per (pid, tid), 'B' and 'E' events pair like a stack: no orphan 'E',
-  no unclosed 'B' at end-of-stream, and each 'E' closes the span the
-  innermost open 'B' opened (name mismatch = interleaving corruption);
-- timestamps are monotonically sane per (pid, tid): an 'E' never
-  precedes its 'B'.
+the program. The structural contract (balanced per-(pid,tid) B/E
+stacks, required fields, monotone E-after-B) is enforced by
+``tools/mxtpu_lint/artifacts.py``; this CLI is a thin wrapper kept for
+its original invocation shape.
 
 Run: ``python tools/check_trace.py DUMP.json [...]``. Exit 0 when every
 file is valid, 1 with one line per violation otherwise. Wired into the
@@ -26,90 +15,19 @@ tier-1 pass via tests/test_trace.py.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
-REQUIRED_TS = ('B', 'E', 'X', 'i', 'C')
+try:
+    from mxtpu_lint import artifacts as _artifacts
+except ImportError:                      # run from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxtpu_lint import artifacts as _artifacts
 
-
-def check_events(events):
-    """[violation strings] for one traceEvents list (empty = valid)."""
-    errors = []
-    if not isinstance(events, list):
-        return [f"traceEvents is {type(events).__name__}, not a list"]
-    stacks = {}
-    for i, ev in enumerate(events):
-        if not isinstance(ev, dict):
-            errors.append(f"event {i}: not an object")
-            continue
-        ph = ev.get('ph')
-        if not isinstance(ph, str) or not ph:
-            errors.append(f"event {i}: missing/invalid 'ph'")
-            continue
-        if ph == 'M':
-            continue
-        if ph in REQUIRED_TS:
-            if not isinstance(ev.get('name'), str):
-                errors.append(f"event {i} (ph={ph}): missing 'name'")
-                continue
-            if not isinstance(ev.get('ts'), (int, float)):
-                errors.append(
-                    f"event {i} ({ev.get('name')!r}): missing/non-numeric "
-                    f"'ts'")
-                continue
-            if 'pid' not in ev or 'tid' not in ev:
-                errors.append(
-                    f"event {i} ({ev['name']!r}): missing pid/tid")
-                continue
-        if ph == 'X' and not (isinstance(ev.get('dur'), (int, float))
-                              and ev['dur'] >= 0):
-            errors.append(
-                f"event {i} ({ev['name']!r}): X event needs dur >= 0")
-        key = (ev.get('pid'), ev.get('tid'))
-        if ph == 'B':
-            stacks.setdefault(key, []).append((ev['name'], ev['ts'], i))
-        elif ph == 'E':
-            stack = stacks.get(key)
-            if not stack:
-                errors.append(
-                    f"event {i} ({ev['name']!r}): orphan 'E' on "
-                    f"pid/tid {key} (no open 'B')")
-                continue
-            bname, bts, bi = stack.pop()
-            if bname != ev['name']:
-                errors.append(
-                    f"event {i}: 'E' for {ev['name']!r} closes open 'B' "
-                    f"{bname!r} (event {bi}) on pid/tid {key} — "
-                    f"interleaved/corrupt stream")
-            if ev['ts'] < bts:
-                errors.append(
-                    f"event {i} ({ev['name']!r}): 'E' ts {ev['ts']} "
-                    f"precedes its 'B' ts {bts}")
-    for key, stack in sorted(stacks.items(), key=lambda kv: str(kv[0])):
-        for name, _ts, i in stack:
-            errors.append(
-                f"unclosed 'B' {name!r} (event {i}) on pid/tid {key} "
-                f"at end of stream")
-    return errors
-
-
-def check_doc(doc):
-    """Validate a parsed dump (object-with-traceEvents or bare array)."""
-    if isinstance(doc, list):
-        return check_events(doc)
-    if isinstance(doc, dict):
-        if 'traceEvents' not in doc:
-            return ["document has no 'traceEvents' key"]
-        return check_events(doc['traceEvents'])
-    return [f"document is {type(doc).__name__}, not an object or array"]
-
-
-def check_file(path):
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"cannot parse as JSON: {e}"]
-    return check_doc(doc)
+# the module-level API tests import (tests/test_trace.py)
+check_events = _artifacts.check_trace_events
+check_doc = _artifacts.check_trace_doc
+check_file = _artifacts.check_trace_file
 
 
 def main(argv=None):

@@ -1,0 +1,30 @@
+"""mxtpu_lint: AST-based invariant checker for the mxnet_tpu package.
+
+One shared walker parses the package once; every rule runs over the
+same ASTs. The enforced invariants are the recurring bug classes the
+last several PRs each hand-fixed one instance of:
+
+- ``host-sync``      device reads (.item(), float()/int() on arrays,
+                     np.asarray, block_until_ready, addressable_shards)
+                     inside functions reachable from the hot-path roots
+- ``jit-purity``     impure host calls (time/os.environ/random/global
+                     mutation/telemetry counters) lexically inside
+                     functions traced by jax.jit/pjit/jax.checkpoint
+- ``lock-order``     cycles in the with-nesting lock acquisition graph
+                     across methods and call edges (potential deadlock)
+- ``signal-safety``  signal/atexit handlers acquiring a non-reentrant
+                     lock without a timeout (the PR-8 SIGTERM bug class)
+- ``knob-drift``     raw os.environ reads of MXTPU_*/MXNET_TPU_* keys
+                     outside config.py; registered knobs absent from
+                     the README
+- ``registry-drift`` faults.fire sites / telemetry metric names /
+                     span names that are not in their declared contract
+
+Run: ``python -m tools.mxtpu_lint``. Findings are suppressible in
+place (``# lint: <rule>-ok <reason>``) or grandfathered in
+``baseline.json``; anything else fails CI. See README "Static
+analysis".
+"""
+from .core import (Baseline, FileIndex, Finding, LintRule,  # noqa: F401
+                   run_rules)
+from .rules import ALL_RULES, rules_by_id  # noqa: F401

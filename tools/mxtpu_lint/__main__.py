@@ -1,0 +1,95 @@
+"""CLI: ``python -m tools.mxtpu_lint [options] [PKG_DIR]``.
+
+Exit codes: 0 clean (no new findings — suppressed and baselined ones
+are reported informationally), 1 new findings, 2 usage/parse errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from .core import Baseline, FileIndex, run_rules
+from .rules import ALL_RULES, rules_by_id
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(HERE, 'baseline.json')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='python -m tools.mxtpu_lint',
+        description='AST-based invariant checker for mxnet_tpu.')
+    ap.add_argument('pkg_dir', nargs='?', default=None,
+                    help='package dir to lint (default: the mxnet_tpu '
+                         'package next to tools/)')
+    ap.add_argument('--rules', default=None,
+                    help='comma-separated rule ids (default: all)')
+    ap.add_argument('--baseline', default=DEFAULT_BASELINE,
+                    help="baseline JSON path, or 'none' to disable")
+    ap.add_argument('--write-baseline', action='store_true',
+                    help='grandfather every current new finding into '
+                         'the baseline file and exit 0')
+    ap.add_argument('--list-rules', action='store_true')
+    ap.add_argument('-q', '--quiet', action='store_true',
+                    help='violations only (no summary line)')
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f'{r.id:16} {r.doc}')
+        return 0
+
+    pkg = args.pkg_dir or os.path.join(
+        os.path.dirname(os.path.dirname(HERE)), 'mxnet_tpu')
+    if not os.path.isdir(pkg):
+        print(f'{pkg}: not a directory', file=sys.stderr)
+        return 2
+
+    rules = rules_by_id(args.rules.split(',') if args.rules else None)
+    baseline = Baseline() if args.baseline == 'none' else \
+        Baseline.load(args.baseline)
+
+    t0 = time.perf_counter()
+    index = FileIndex(pkg)
+    t_parse = time.perf_counter() - t0
+    for path, err in index.errors:
+        print(f'{path}: parse error: {err}', file=sys.stderr)
+    if index.errors:
+        return 2
+
+    result = run_rules(index, rules, baseline)
+    t_total = time.perf_counter() - t0
+
+    if args.write_baseline:
+        for f in result.new:
+            baseline.add(f, 'grandfathered by --write-baseline; burn '
+                            'down or justify')
+        baseline.write(args.baseline)
+        print(f'baseline: wrote {len(result.new)} new entr'
+              f'{"y" if len(result.new) == 1 else "ies"} '
+              f'({len(baseline.entries)} total) to {args.baseline}')
+        return 0
+
+    for f in result.new:
+        print(f.format(), file=sys.stderr)
+    if not args.quiet:
+        for fp in result.stale:
+            ent = baseline.entries[fp]
+            print(f"note: stale baseline entry {fp} "
+                  f"({ent['rule']} @ {ent['path']}) — finding no "
+                  f"longer produced; prune it", file=sys.stderr)
+        n_files = len(index.files)
+        n_funcs = len(index.functions)
+        print(f"mxtpu_lint: {len(result.new)} new finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} suppressed in-place over "
+              f"{n_files} files / {n_funcs} functions "
+              f"[{len(rules)} rules, parse {t_parse * 1e3:.0f} ms, "
+              f"total {t_total * 1e3:.0f} ms]")
+    return 1 if result.errors else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Artifact validators shared by the thin check_* CLIs.
+
+The lint rules check SOURCE against contracts; these helpers check the
+ARTIFACTS the instrumented code emits (chrome-trace dumps, checkpoint
+step dirs) against the same promises. tools/check_trace.py and
+tools/check_checkpoint_manifest.py are thin argparse/printing wrappers
+over this module (exit codes unchanged); tests import the functions
+directly.
+
+Standalone by design: nothing here imports mxnet_tpu (or jax) at
+module level — the checkpoint scanner loads ``checkpoint/manifest.py``
+by file path, so both CLIs run on a storage host with no framework
+installed.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+# ---------------------------------------------------------------------------
+# chrome-trace dumps (tools/check_trace.py)
+# ---------------------------------------------------------------------------
+
+REQUIRED_TS = ('B', 'E', 'X', 'i', 'C')
+
+
+def check_trace_events(events):
+    """[violation strings] for one traceEvents list (empty = valid)."""
+    errors = []
+    if not isinstance(events, list):
+        return [f"traceEvents is {type(events).__name__}, not a list"]
+    stacks = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = ev.get('ph')
+        if not isinstance(ph, str) or not ph:
+            errors.append(f"event {i}: missing/invalid 'ph'")
+            continue
+        if ph == 'M':
+            continue
+        if ph in REQUIRED_TS:
+            if not isinstance(ev.get('name'), str):
+                errors.append(f"event {i} (ph={ph}): missing 'name'")
+                continue
+            if not isinstance(ev.get('ts'), (int, float)):
+                errors.append(
+                    f"event {i} ({ev.get('name')!r}): missing/non-numeric "
+                    f"'ts'")
+                continue
+            if 'pid' not in ev or 'tid' not in ev:
+                errors.append(
+                    f"event {i} ({ev['name']!r}): missing pid/tid")
+                continue
+        if ph == 'X' and not (isinstance(ev.get('dur'), (int, float))
+                              and ev['dur'] >= 0):
+            errors.append(
+                f"event {i} ({ev['name']!r}): X event needs dur >= 0")
+        key = (ev.get('pid'), ev.get('tid'))
+        if ph == 'B':
+            stacks.setdefault(key, []).append((ev['name'], ev['ts'], i))
+        elif ph == 'E':
+            stack = stacks.get(key)
+            if not stack:
+                errors.append(
+                    f"event {i} ({ev['name']!r}): orphan 'E' on "
+                    f"pid/tid {key} (no open 'B')")
+                continue
+            bname, bts, bi = stack.pop()
+            if bname != ev['name']:
+                errors.append(
+                    f"event {i}: 'E' for {ev['name']!r} closes open 'B' "
+                    f"{bname!r} (event {bi}) on pid/tid {key} — "
+                    f"interleaved/corrupt stream")
+            if ev['ts'] < bts:
+                errors.append(
+                    f"event {i} ({ev['name']!r}): 'E' ts {ev['ts']} "
+                    f"precedes its 'B' ts {bts}")
+    for key, stack in sorted(stacks.items(), key=lambda kv: str(kv[0])):
+        for name, _ts, i in stack:
+            errors.append(
+                f"unclosed 'B' {name!r} (event {i}) on pid/tid {key} "
+                f"at end of stream")
+    return errors
+
+
+def check_trace_doc(doc):
+    """Validate a parsed dump (object-with-traceEvents or bare array)."""
+    if isinstance(doc, list):
+        return check_trace_events(doc)
+    if isinstance(doc, dict):
+        if 'traceEvents' not in doc:
+            return ["document has no 'traceEvents' key"]
+        return check_trace_events(doc['traceEvents'])
+    return [f"document is {type(doc).__name__}, not an object or array"]
+
+
+def check_trace_file(path):
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"cannot parse as JSON: {e}"]
+    return check_trace_doc(doc)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint trees (tools/check_checkpoint_manifest.py)
+# ---------------------------------------------------------------------------
+
+EXIT_CLEAN = 0
+EXIT_USAGE = 1        # also the legacy (non --scrub) failure code
+EXIT_CORRUPT = 2
+EXIT_MISSING = 3
+
+
+def load_manifest_module():
+    """mxnet_tpu/checkpoint/manifest.py by file path (no framework or
+    jax import — usable on a storage host)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(os.path.dirname(here)),
+                        'mxnet_tpu', 'checkpoint', 'manifest.py')
+    spec = importlib.util.spec_from_file_location('_ckpt_manifest', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def scan_step_dir(mf, step_dir):
+    """(ok, verdict line, [(kind, failure line)]) for one step dir."""
+    doc, problems = mf.scan_step_dir(step_dir)
+    if problems:
+        return False, None, [
+            (kind, f"FAIL {step_dir}: [{kind}] {detail}")
+            for kind, detail in problems]
+    n_arr = len(doc.get('arrays', []))
+    n_blob = len(doc.get('blobs', []))
+    line = (f"OK   {step_dir}: step {doc.get('step')}, {n_arr} arrays, "
+            f"{n_blob} blobs, {doc.get('total_bytes', '?')} bytes, "
+            f"all sha256 verified")
+    return True, line, []
+
+
+def collect_targets(mf, path, step=None, latest=False, scrub=False):
+    """(targets, notes, usage_error) — the step dirs one CLI run
+    verifies, informational notes (stale tmp dirs, retired re-save
+    copies, quarantines), and a usage-error line (None when valid)."""
+    notes = []
+    if os.path.isfile(os.path.join(path, mf.MANIFEST_NAME)):
+        return [path], notes, None
+    steps = mf.committed_steps(path)
+    if step is not None:
+        if step not in steps:
+            return [], notes, (f"{path}: no committed step {step} "
+                               f"(have {steps})")
+        steps = [step]
+    elif latest:
+        if not steps:
+            return [], notes, f"{path}: no committed steps"
+        steps = steps[-1:]
+    elif not steps and not scrub:
+        return [], notes, (f"{path}: no committed steps and no "
+                           f"{mf.MANIFEST_NAME}")
+    targets = [os.path.join(path, mf.step_dir_name(s)) for s in steps]
+    for tmp in mf.stale_tmp_dirs(path):
+        notes.append(f"note: stale uncommitted write {tmp} (crash "
+                     f"leftover; ignored by restore, swept by the next "
+                     f"manager)")
+    for old, final in mf.stale_old_dirs(path):
+        state = 'recovery source — final copy missing, the next ' \
+            'manager rolls it back' if not os.path.isdir(final) \
+            else 'superseded copy, swept by the next manager'
+        notes.append(f"note: retired re-save copy {old} ({state})")
+    for q, qstep in mf.quarantined_dirs(path):
+        notes.append(f"note: quarantined copy {q} (step {qstep} failed "
+                     f"a scrub/restore re-hash; evidence, never a "
+                     f"restore target, expires with retention)")
+    if scrub:
+        # hosted peer replicas ride the same deep verification:
+        # a replica this host cannot vouch for is not survivability
+        for ns in mf.replica_namespaces(path):
+            nsdir = os.path.join(path, mf.REPLICA_SUBDIR, ns)
+            for s in mf.committed_steps(nsdir):
+                targets.append(os.path.join(nsdir, mf.step_dir_name(s)))
+    return targets, notes, None
+
+
+def scrub_exit_code(targets, kinds):
+    """--scrub exit-code ladder: corrupt dominates missing dominates
+    clean; an EMPTY scan is missing (a wiped checkpoint root must
+    never pass the CI deep scan as clean)."""
+    if not targets:
+        return EXIT_MISSING
+    if 'corrupt' in kinds:
+        return EXIT_CORRUPT
+    if 'missing' in kinds:
+        return EXIT_MISSING
+    return EXIT_CLEAN

@@ -1,0 +1,221 @@
+"""Shared contract data for the lint rules and the check_* wrappers.
+
+This is the single home of every string-keyed contract the package
+relies on reviewers remembering: telemetry metric names (formerly the
+private table in check_telemetry_names.py), trace span names, and the
+hot-path roots the host-sync rule measures reachability from. The
+fault-site registry is NOT duplicated here — resilience/faults.py's
+``_SITES`` dict is parsed from its AST so the code stays the registry.
+"""
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# telemetry metric names (registry-drift rule; check_telemetry_names.py
+# re-exports these so external callers keep working)
+# ---------------------------------------------------------------------------
+
+NAME_RE = re.compile(r'^mxnet_tpu_[a-z][a-z0-9_]*$')
+
+# call name -> metric kind it implies (None: kind-agnostic read)
+KINDS = {
+    'inc': 'counter', 'counter': 'counter',
+    'set_gauge': 'gauge', 'gauge': 'gauge',
+    'observe': 'histogram', 'histogram': 'histogram',
+    'value': None,
+}
+
+# Subsystem contracts: metric sets that dashboards/docs (README,
+# PERF_NOTES) reference by name, with their kinds. The lint fails when
+# an instrumentation site drops/renames one of these, or adds a new
+# metric under the subsystem prefix without declaring it here — keeping
+# code, docs and dashboards from drifting apart silently.
+SUBSYSTEM_METRICS = {
+    'mxnet_tpu_io_': {
+        # batch production
+        'mxnet_tpu_io_batches_total': 'counter',
+        'mxnet_tpu_io_batch_latency_seconds': 'histogram',
+        # host-boundary traffic: bytes the python layer pulls out of the
+        # pipeline per batch (u8 transport moves ~4x less than f32)
+        'mxnet_tpu_io_host_bytes_total': 'counter',
+        # zero-copy buffer leases outstanding against the native pipeline
+        'mxnet_tpu_io_lease_depth': 'gauge',
+        # decode cache (decoded+resized images reused across epochs)
+        'mxnet_tpu_io_decode_cache_hits_total': 'counter',
+        'mxnet_tpu_io_decode_cache_misses_total': 'counter',
+        'mxnet_tpu_io_decode_cache_bytes': 'gauge',
+        # decode-prefetch health (PrefetchingIter)
+        'mxnet_tpu_io_prefetch_miss_total': 'counter',
+        'mxnet_tpu_io_prefetch_stall_seconds_total': 'counter',
+        # device prefetch: batches staged on device ahead of the
+        # consumer, and the dispatch-to-consume window each host->device
+        # copy had to overlap compute in
+        'mxnet_tpu_io_device_prefetch_depth': 'gauge',
+        'mxnet_tpu_io_h2d_overlap_seconds_total': 'counter',
+        # corrupt/truncated records silently substituted under
+        # MXNET_TPU_IO_CORRUPT_POLICY=skip (error-policy raises
+        # DataError and counts nothing)
+        'mxnet_tpu_io_corrupt_records_total': 'counter',
+    },
+    'mxnet_tpu_resilience_': {
+        # fault injection: every armed-site firing, by site + kind
+        'mxnet_tpu_resilience_faults_injected_total': 'counter',
+        # bounded retry/backoff helper (checkpoint writes, ...), by site
+        'mxnet_tpu_resilience_retries_total': 'counter',
+        # non-finite guard: bad (skipped-on-device) steps, rollbacks to
+        # the last committed checkpoint, and how long recovery took
+        'mxnet_tpu_resilience_bad_steps_total': 'counter',
+        'mxnet_tpu_resilience_rollbacks_total': 'counter',
+        'mxnet_tpu_resilience_last_rollback_step': 'gauge',
+        'mxnet_tpu_resilience_recovery_seconds': 'histogram',
+        # step watchdog stall dumps and DataLoader worker respawns
+        'mxnet_tpu_resilience_watchdog_stalls_total': 'counter',
+        'mxnet_tpu_resilience_worker_respawns_total': 'counter',
+    },
+    'mxnet_tpu_comm_': {
+        # collective traffic accounting (ZeRO / GSPMD dp path):
+        # ring-algorithm wire bytes per device by collective kind
+        # (reduce_scatter / all_gather / all_reduce / broadcast /
+        # state_scatter / param_scatter) and mesh axis. The GSPMD step
+        # counters additionally carry a `stage` label (off / zero1 /
+        # zero3) separating the ZeRO-1 writeback gather from the ZeRO-3
+        # per-layer on-use gathers: ZeRO-1 must show the SAME total
+        # bytes as the replicated update while the optimizer-state
+        # gauge drops to ~1/dp; ZeRO-3 adds the param regather wire
+        # bytes while the param gauge also drops to ~1/dp. The per-step
+        # trace instants (`comm.all_gather`) carry per-layer bytes via
+        # a `layer` arg for gather-vs-compute overlap attribution.
+        'mxnet_tpu_comm_collective_bytes_total': 'counter',
+        'mxnet_tpu_comm_collectives_total': 'counter',
+        # optimizer state (fp32 masters + moments) held by ONE device
+        'mxnet_tpu_comm_opt_state_bytes_per_device': 'gauge',
+        # persistent params (compute dtype) held by ONE device — the
+        # ZeRO-3 1/dp param residency is auditable against it
+        'mxnet_tpu_comm_param_bytes_per_device': 'gauge',
+    },
+    'mxnet_tpu_elastic_': {
+        # elastic multi-host training (membership side channel +
+        # commit/re-form/resume controller): heartbeat round-trips
+        # sent, peers declared lost past MXTPU_PEER_DEADLINE_SECONDS,
+        # completed mesh re-forms, the survivor world size after the
+        # newest re-form, and the detect->commit->teardown->restore
+        # wall time of each re-form (the MTTR the CPU drill records)
+        'mxnet_tpu_elastic_heartbeats_total': 'counter',
+        'mxnet_tpu_elastic_peer_losses_total': 'counter',
+        'mxnet_tpu_elastic_reforms_total': 'counter',
+        'mxnet_tpu_elastic_last_world_size': 'gauge',
+        'mxnet_tpu_elastic_reform_seconds': 'histogram',
+    },
+    'mxnet_tpu_trace_': {
+        # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
+        # dropped by ring overwrite, events currently buffered across
+        # every thread ring, and flight-recorder post-mortem dumps
+        'mxnet_tpu_trace_spans_total': 'counter',
+        'mxnet_tpu_trace_dropped_spans_total': 'counter',
+        'mxnet_tpu_trace_ring_depth': 'gauge',
+        'mxnet_tpu_trace_flight_dumps_total': 'counter',
+    },
+    'mxnet_tpu_checkpoint_': {
+        'mxnet_tpu_checkpoint_save_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_restore_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_bytes': 'gauge',
+        'mxnet_tpu_checkpoint_last_step': 'gauge',
+        'mxnet_tpu_checkpoint_saves_total': 'counter',
+        'mxnet_tpu_checkpoint_gc_total': 'counter',
+        'mxnet_tpu_checkpoint_corrupt_total': 'counter',
+        # survivability layer (ISSUE 10): peer replication of committed
+        # steps over the membership side channel — successful pushes /
+        # wire bytes / bounded-retry-exhausted failures (by peer rank),
+        # local-commit-to-replica-commit lag, any-replica restore
+        # fetches, and replica retirements (retention GC on the owner,
+        # replica_delete on the receiver, orphan GC on a scrub pass)
+        'mxnet_tpu_checkpoint_replica_pushes_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_bytes_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_failures_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_lag_seconds': 'histogram',
+        'mxnet_tpu_checkpoint_replica_fetches_total': 'counter',
+        'mxnet_tpu_checkpoint_replica_gc_total': 'counter',
+        # background integrity scrubber: passes completed, committed
+        # steps (local or hosted) that failed their re-hash and were
+        # quarantined, steps repaired bit-identical from a healthy
+        # replica, and the wall cost of one pass
+        'mxnet_tpu_checkpoint_scrub_passes_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_corrupt_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_repaired_total': 'counter',
+        'mxnet_tpu_checkpoint_scrub_seconds': 'histogram',
+    },
+}
+
+# ---------------------------------------------------------------------------
+# trace span/instant names (registry-drift rule). A span name not in
+# this contract is either a typo or a new subsystem the attribution
+# bucketing (telemetry/attribution.py) and docs have never heard of —
+# declare it here when adding the instrumentation.
+# ---------------------------------------------------------------------------
+
+SPAN_NAMES = frozenset({
+    # io pipeline
+    'io.batch', 'io.decode', 'io.lease', 'io.prefetch_wait', 'io.wait',
+    'io.worker_fetch',
+    # host->device staging
+    'h2d.batch_put', 'h2d.device_put', 'h2d.normalize',
+    'h2d.param_place', 'h2d.pin',
+    # step lifecycle
+    'step.dispatch', 'step.compiled', 'step.gather',
+    # collectives (spans on the gluon path, per-step instants on the
+    # GSPMD path carrying analytic ring-wire bytes)
+    # (the GSPMD instants interpolate the kind: f'comm.{kind}' — the
+    # static rule checks literals, the kind set is declared here)
+    'comm.allreduce', 'comm.broadcast', 'comm.all_gather',
+    'comm.reduce_scatter', 'comm.all_reduce', 'comm.state_scatter',
+    'comm.param_scatter',
+    # optimizer
+    'optimizer.update', 'optimizer.fused', 'optimizer.state_init',
+    # checkpointing
+    'checkpoint.snapshot', 'checkpoint.write', 'checkpoint.restore',
+    # host syncs made visible
+    'sync.lease_drain',
+    # resilience
+    'guard.rollback', 'elastic.reform',
+})
+
+# ---------------------------------------------------------------------------
+# hot-path roots (host-sync rule): the dispatch entry points a training
+# step flows through. Reachability is measured from these; a host sync
+# inside the cone (and inside a hot-path module) blocks the step
+# pipeline and must either move, defer, or carry a reasoned
+# `# lint: host-sync-ok` marker.
+# ---------------------------------------------------------------------------
+
+# (relpath suffix, qualname glob)
+HOT_PATH_ROOTS = [
+    ('parallel/step.py', 'ShardedTrainStep.__call__'),
+    ('parallel/step.py', 'ShardedTrainStep._call_traced'),
+    ('gluon/trainer.py', 'Trainer.step'),
+    ('gluon/trainer.py', 'Trainer.update'),
+    ('gluon/trainer.py', 'Trainer._update'),
+    ('gluon/trainer.py', 'Trainer._allreduce_grads'),
+    ('gluon/trainer.py', 'Trainer._fused_apply'),
+    # span/flight recording runs inside the step on the hot threads
+    ('telemetry/trace.py', 'span'),
+    ('telemetry/trace.py', 'instant'),
+    ('telemetry/trace.py', 'complete'),
+    ('telemetry/flight.py', 'FlightRecorder.record_step'),
+    ('telemetry/flight.py', 'FlightRecorder.note'),
+    ('telemetry/flight.py', 'FlightRecorder.annotate_last'),
+]
+
+# host-sync findings are reported only inside these modules (the cone
+# from the roots also reaches cold paths — checkpoint restore, error
+# formatting — where a host read is fine)
+HOT_PATH_FILES = (
+    'parallel/step.py',
+    'parallel/collectives.py',
+    'gluon/trainer.py',
+    'gluon/data/dataloader.py',
+    'telemetry/trace.py',
+    'telemetry/flight.py',
+    'io/io.py',
+)

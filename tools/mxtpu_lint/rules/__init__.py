@@ -1,0 +1,26 @@
+"""Rule registry: one instance of every shipped rule."""
+from .host_sync import HostSyncRule
+from .jit_purity import JitPurityRule
+from .knobs import KnobDriftRule
+from .locks import LockOrderRule, SignalSafetyRule
+from .registry_drift import RegistryDriftRule
+
+ALL_RULES = [
+    HostSyncRule(),
+    JitPurityRule(),
+    LockOrderRule(),
+    SignalSafetyRule(),
+    KnobDriftRule(),
+    RegistryDriftRule(),
+]
+
+
+def rules_by_id(ids=None):
+    if not ids:
+        return list(ALL_RULES)
+    table = {r.id: r for r in ALL_RULES}
+    missing = [i for i in ids if i not in table]
+    if missing:
+        raise SystemExit(f"unknown rule id(s): {missing}; "
+                         f"have {sorted(table)}")
+    return [table[i] for i in ids]

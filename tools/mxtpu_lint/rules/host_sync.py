@@ -1,0 +1,132 @@
+"""host-sync: device reads inside the hot-path cone.
+
+A host sync (`.item()`, `float()`/`int()` on an array, `np.asarray`,
+`.block_until_ready()`, `.addressable_shards`) inside the step
+pipeline blocks the dispatching thread on device completion and
+serializes the async runtime — the exact class of stall PR 6's span
+tracer had to hunt down one instance at a time. The rule computes
+reachability from the dispatch roots (``contracts.HOT_PATH_ROOTS``)
+over the shared call graph and flags sync sites in hot-path modules.
+
+Deliberate, understood syncs (a one-step-deferred loss read, a drain
+before buffer reuse) carry ``# lint: host-sync-ok <reason>`` — the
+reason is the documentation the next reader needs.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from .. import contracts
+from ..core import FileIndex, LintRule, dotted_name
+
+# float()/int() on one of these argument shapes is treated as a
+# potential device read; anything else (literals, len(), arithmetic)
+# is host math. Names matching the hints are how arrays are spelled
+# in this codebase; the heuristic is documented in the README.
+_ARRAYISH_NAME_HINTS = ('loss', 'grad', 'flag', 'arr', 'array', 'out',
+                        'scalar', 'tensor', 'nd', 'data')
+
+
+class HostSyncRule(LintRule):
+    id = 'host-sync'
+    doc = ('host-sync reads (.item/float/int-on-array/np.asarray/'
+           'block_until_ready/.addressable_shards) reachable from the '
+           'hot-path dispatch roots')
+
+    def __init__(self, roots=None, hot_files=None):
+        self.roots = roots if roots is not None else \
+            contracts.HOT_PATH_ROOTS
+        self.hot_files = tuple(hot_files if hot_files is not None
+                               else contracts.HOT_PATH_FILES)
+
+    # -- root/reachability -------------------------------------------------
+
+    def _root_keys(self, index: FileIndex):
+        keys = []
+        for suffix, qual_glob in self.roots:
+            for sf in index.files_matching(suffix):
+                for (rel, qual), fi in index.functions.items():
+                    if rel == sf.relpath and fnmatch.fnmatch(qual,
+                                                             qual_glob):
+                        keys.append(fi.key)
+        return keys
+
+    def run(self, index: FileIndex):
+        findings = []
+        reached = index.reachable(self._root_keys(index))
+        for key, root in sorted(reached.items()):
+            fi = index.functions[key]
+            if not fi.file.relpath.endswith(tuple(self.hot_files)):
+                continue
+            for node in index.walk_function(fi):
+                hit = self._sync_site(fi.file, node)
+                if hit is None:
+                    continue
+                what, detail = hit
+                findings.append(self.finding(
+                    fi.file, node.lineno,
+                    f"{what} is a host sync on the hot path "
+                    f"(reachable from {root[1]}){detail}",
+                    symbol=fi.qualname))
+        return findings
+
+    # -- site matching -----------------------------------------------------
+
+    def _sync_site(self, sf, node):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == 'item' and not node.args:
+                    return ('.item()', '')
+                if node.func.attr == 'block_until_ready':
+                    return ('.block_until_ready()',
+                            ' — blocks until device completion')
+                if node.func.attr == 'asarray' and \
+                        self._is_numpy(sf, node.func.value):
+                    return ('np.asarray(...)',
+                            ' — device->host copy')
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ('float', 'int') and \
+                    len(node.args) == 1 and \
+                    self._arrayish(node.args[0]):
+                return (f'{node.func.id}() on an array-like value',
+                        ' — forces a device read')
+            return None
+        if isinstance(node, ast.Attribute) and \
+                node.attr == 'addressable_shards' and \
+                isinstance(node.ctx, ast.Load):
+            return ('.addressable_shards',
+                    ' — materializes per-device buffers on the host')
+        return None
+
+    @staticmethod
+    def _is_numpy(sf, expr) -> bool:
+        # host numpy only: jnp.asarray stages TO the device and never
+        # forces a device->host read, so it is not a sync site
+        if not isinstance(expr, ast.Name):
+            return False
+        return sf.imports.get(expr.id, '') == 'numpy'
+
+    @staticmethod
+    def _arrayish(arg) -> bool:
+        """Heuristic: does this float()/int() argument look like a
+        device array? Names carrying array-ish hints, `._data`/`.item`
+        attribute chains, and getattr(x, '_data', ...) unwraps."""
+        def name_of(e):
+            if isinstance(e, ast.Name):
+                return e.id
+            if isinstance(e, ast.Attribute):
+                return e.attr
+            return ''
+        if isinstance(arg, ast.Attribute) and arg.attr == '_data':
+            return True
+        if isinstance(arg, ast.Call):
+            f = arg.func
+            if isinstance(f, ast.Name) and f.id == 'getattr' and \
+                    any(isinstance(a, ast.Constant) and a.value == '_data'
+                        for a in arg.args):
+                return True
+            return False
+        n = name_of(arg).lower()
+        return any(h in n for h in _ARRAYISH_NAME_HINTS)

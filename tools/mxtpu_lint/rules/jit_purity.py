@@ -1,0 +1,117 @@
+"""jit-purity: impure host calls lexically inside traced functions.
+
+A function handed to ``jax.jit``/``pjit``/``jax.checkpoint`` (or
+decorated with one) runs ONCE at trace time; host side effects inside
+it silently freeze into the compiled program — `time.time()` becomes a
+constant, `os.environ` reads bake the tracing process's env in,
+telemetry counters count compilations instead of steps, and stdlib
+`random` desyncs from the captured PRNG keys. The rule finds the
+traced-function set per file and flags those constructs lexically
+inside them (nested defs included).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileIndex, LintRule, dotted_name, resolves_to_module
+
+_TRACERS = ('jax.jit', 'jit', 'pjit', 'jax.pjit', 'jax.checkpoint',
+            'checkpoint')
+_METRIC_CALLS = ('inc', 'observe', 'set_gauge')
+_METRIC_RECEIVER_HINTS = ('telemetry', 'metrics', '_telemetry',
+                          '_metrics')
+
+
+class JitPurityRule(LintRule):
+    id = 'jit-purity'
+    doc = ('impure host calls (time/os.environ/stdlib random/global '
+           'mutation/telemetry counters) inside jit/pjit/checkpoint-'
+           'traced functions')
+
+    def run(self, index: FileIndex):
+        findings = []
+        for sf in index.files:
+            traced = self._traced_functions(index, sf)
+            for fi in traced:
+                for node in ast.walk(fi.node):
+                    hit = self._impurity(sf, node)
+                    if hit is None:
+                        continue
+                    findings.append(self.finding(
+                        sf, node.lineno,
+                        f"{hit} inside a traced function — it runs at "
+                        f"trace time, not per step", symbol=fi.qualname))
+        return findings
+
+    # -- traced-function discovery ----------------------------------------
+
+    def _traced_functions(self, index, sf):
+        """FuncInfos in `sf` that are jitted: passed (by name) to a
+        tracer call, or decorated with one."""
+        out = []
+        traced_names = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._tracer_name(sf, node.func) and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                traced_names.add(node.args[0].id)
+        for fi in index.functions.values():
+            if fi.file is not sf:
+                continue
+            if fi.name in traced_names or self._traced_decorator(sf, fi):
+                out.append(fi)
+        return out
+
+    def _traced_decorator(self, sf, fi) -> bool:
+        for dec in fi.node.decorator_list:
+            if self._tracer_name(sf, dec):
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+            if isinstance(dec, ast.Call):
+                if self._tracer_name(sf, dec.func):
+                    return True
+                dn = dotted_name(dec.func)
+                if dn.endswith('partial') and dec.args and \
+                        self._tracer_name(sf, dec.args[0]):
+                    return True
+        return False
+
+    @staticmethod
+    def _tracer_name(sf, expr) -> bool:
+        dn = dotted_name(expr)
+        if not dn:
+            return False
+        if dn in ('jax.jit', 'jax.pjit', 'jax.checkpoint'):
+            return True
+        # bare names must resolve to jax via imports (from jax import
+        # jit / from jax.experimental.pjit import pjit)
+        if dn in ('jit', 'pjit', 'checkpoint'):
+            target = sf.imports.get(dn, '')
+            return target.startswith('jax')
+        return False
+
+    # -- impurity matching -------------------------------------------------
+
+    def _impurity(self, sf, node):
+        if isinstance(node, ast.Global):
+            return f"global {', '.join(node.names)} (mutation intent)"
+        if isinstance(node, ast.Attribute) and node.attr == 'environ' \
+                and resolves_to_module(sf, node.value, 'os'):
+            return 'os.environ access'
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            mod = sf.imports.get(func.value.id, '')
+            if mod == 'time':
+                return f'time.{func.attr}()'
+            if mod == 'random':           # stdlib random, not jax.random
+                return f'random.{func.attr}() (stdlib RNG)'
+            if func.attr in _METRIC_CALLS and (
+                    func.value.id in _METRIC_RECEIVER_HINTS
+                    or mod.endswith(('telemetry', 'telemetry.metrics'))):
+                return (f'telemetry counter {func.attr}() — counts '
+                        f'trace-time executions')
+        return None

@@ -1,0 +1,126 @@
+"""knob-drift: env knobs must go through config.register.
+
+Raw ``os.environ`` reads of ``MXTPU_*``/``MXNET_TPU_*`` keys outside
+``config.py`` bypass the typed registry: no declared default, no
+``describe()`` documentation, no ``set_env`` validation — the knob
+exists only in the head of whoever grepped for it last. The rule also
+closes the docs half of the loop: every knob ``config.py`` registers
+must appear in the README, or the registry documents a surface users
+cannot discover.
+
+Writes (``os.environ['MXTPU_X'] = ...``) are NOT flagged: setting a
+child process's environment (drills, launch helpers) is how the knobs
+are legitimately passed around.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import FileIndex, LintRule, dotted_name, str_const
+
+KNOB_PREFIXES = ('MXTPU_', 'MXNET_TPU_')
+
+
+class KnobDriftRule(LintRule):
+    id = 'knob-drift'
+    doc = ('raw os.environ reads of MXTPU_*/MXNET_TPU_* outside '
+           'config.py; registered knobs missing from README')
+
+    def __init__(self, config_suffix='config.py', readme_path=None,
+                 readme_text=None):
+        self.config_suffix = config_suffix
+        self.readme_path = readme_path
+        self.readme_text = readme_text
+
+    def run(self, index: FileIndex):
+        findings = []
+        findings += self._raw_env_reads(index)
+        findings += self._undocumented_knobs(index)
+        return findings
+
+    # -- raw env reads -----------------------------------------------------
+
+    def _raw_env_reads(self, index):
+        findings = []
+        for sf in index.files:
+            if sf.relpath.endswith(self.config_suffix):
+                continue
+            for node in ast.walk(sf.tree):
+                key = self._environ_read_key(sf, node)
+                if key is None or not key.startswith(KNOB_PREFIXES):
+                    continue
+                findings.append(self.finding(
+                    sf, node.lineno,
+                    f"raw os.environ read of {key!r} — declare it with "
+                    f"config.register and read it via config.get "
+                    f"(typed, defaulted, documented)",
+                    symbol=key))
+        return findings
+
+    @staticmethod
+    def _environ_read_key(sf, node):
+        """Literal key of an os.environ read (subscript load /
+        .get / os.getenv), else None."""
+        def is_environ(expr):
+            return (isinstance(expr, ast.Attribute)
+                    and expr.attr == 'environ'
+                    and isinstance(expr.value, ast.Name)
+                    and sf.imports.get(expr.value.id, '') == 'os')
+        if isinstance(node, ast.Subscript) and is_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            return str_const(node.slice)
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == 'get' and \
+                    is_environ(node.func.value) and node.args:
+                return str_const(node.args[0])
+            if dn.endswith('.getenv') and \
+                    sf.imports.get(dn.split('.')[0], '') == 'os' and \
+                    node.args:
+                return str_const(node.args[0])
+        return None
+
+    # -- registered knobs documented --------------------------------------
+
+    def _undocumented_knobs(self, index):
+        cfgs = index.files_matching(self.config_suffix)
+        if not cfgs:
+            return []
+        cfg = cfgs[0]
+        readme = self._readme(index)
+        if readme is None:
+            return []
+        findings = []
+        for name, lineno in self._registered_knobs(cfg):
+            if not re.search(re.escape(name) + r'\b', readme):
+                findings.append(self.finding(
+                    cfg, lineno,
+                    f"knob {name} is registered but never mentioned in "
+                    f"the README — document it (or drop the "
+                    f"registration)", symbol=name))
+        return findings
+
+    @staticmethod
+    def _registered_knobs(cfg):
+        out = []
+        for node in ast.walk(cfg.tree):
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).endswith('register') and \
+                    node.args:
+                name = str_const(node.args[0])
+                if name:
+                    out.append((name, node.lineno))
+        return out
+
+    def _readme(self, index):
+        if self.readme_text is not None:
+            return self.readme_text
+        path = self.readme_path or os.path.join(index.root, 'README.md')
+        try:
+            with open(path, encoding='utf-8') as f:
+                return f.read()
+        except OSError:
+            return None

@@ -1,0 +1,435 @@
+"""lock-order + signal-safety analysis over the shared call graph.
+
+Lock identity is static: ``self._lock = threading.Lock()`` in class C
+of file F names lock ``F::C._lock``; a module-level assignment names
+``F::_lock``. Acquisition sites are ``with <lock>:`` statements and
+``<lock>.acquire(...)`` calls; a ``with`` over a method call is
+resolved through the callee — a contextmanager that acquires exactly
+one lock (``with self._locked_for_dump():``) holds that lock for the
+body.
+
+**lock-order** builds the held->acquired edge set: lexical nesting
+plus call edges (holding A while calling a function whose reachable
+set acquires B adds A->B). A cycle means two threads can interleave
+into a deadlock. Self-edges on reentrant locks are fine; A->B->A is
+reported regardless of kind — reentrancy does not help across locks.
+
+**signal-safety** roots at every handler registered via
+``signal.signal``/``atexit.register`` (factories included: a nested
+handler is reachable from the factory that builds it) and flags any
+blocking acquisition of a NON-reentrant lock in the reachable set.
+A signal handler runs on the main thread at an arbitrary bytecode
+boundary: if the interrupted frame holds that lock, the handler
+deadlocks the process — the exact PR-8 SIGTERM bug. RLock/Condition
+acquisitions are exempt (main-thread reentrancy), as is any
+``.acquire(timeout=...)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileIndex, FuncInfo, LintRule, dotted_name
+
+
+class LockInfo:
+    __slots__ = ('key', 'kind', 'file', 'line')
+
+    def __init__(self, key, kind, file, line):
+        self.key = key          # 'relpath::Class.attr' / 'relpath::name'
+        self.kind = kind        # 'lock' | 'rlock'
+        self.file = file
+        self.line = line
+
+
+class Acquire:
+    __slots__ = ('lock', 'node', 'fi', 'blocking', 'via_with', 'body')
+
+    def __init__(self, lock, node, fi, blocking, via_with, body=None):
+        self.lock = lock        # LockInfo
+        self.node = node
+        self.fi = fi
+        self.blocking = blocking     # False when timeout=/blocking=False
+        self.via_with = via_with
+        self.body = body or []       # held-range statements (with only)
+
+
+class LockModel:
+    """Locks, acquisition sites, and the held->acquired edge set for
+    one FileIndex. Built once, shared by both rules."""
+
+    def __init__(self, index: FileIndex):
+        self.index = index
+        self.locks: Dict[str, LockInfo] = {}
+        self.acquires: Dict[Tuple[str, str], List[Acquire]] = {}
+        self._find_locks()
+        self._find_acquires()
+        self._reach_cache: Dict[Tuple[str, str], Set[str]] = {}
+
+    # -- lock discovery ----------------------------------------------------
+
+    _CTORS = {'Lock': 'lock', 'RLock': 'rlock', 'Condition': 'rlock',
+              'Semaphore': 'lock', 'BoundedSemaphore': 'lock'}
+
+    def _lock_ctor_kind(self, sf, value) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        dn = dotted_name(value.func)
+        if '.' in dn:
+            mod, attr = dn.rsplit('.', 1)
+            if sf.imports.get(mod, mod) == 'threading' and \
+                    attr in self._CTORS:
+                if attr == 'Condition' and value.args:
+                    # Condition(threading.Lock()) wraps a plain lock
+                    inner = dotted_name(value.args[0].func) \
+                        if isinstance(value.args[0], ast.Call) else ''
+                    if inner.endswith('Lock') and \
+                            not inner.endswith('RLock'):
+                        return 'lock'
+                return self._CTORS[attr]
+        elif dn in self._CTORS and sf.imports.get(dn, '').startswith(
+                'threading'):
+            return self._CTORS[dn]
+        return None
+
+    def _find_locks(self):
+        for sf in self.index.files:
+            for fi_key, fi in self.index.functions.items():
+                if fi.file is not sf:
+                    continue
+                for node in self.index.walk_function(fi):
+                    if isinstance(node, ast.Assign):
+                        self._maybe_lock_assign(sf, fi.cls, node)
+            for node in sf.tree.body:       # module level
+                if isinstance(node, ast.Assign):
+                    self._maybe_lock_assign(sf, None, node)
+
+    def _maybe_lock_assign(self, sf, cls, node):
+        kind = self._lock_ctor_kind(sf, node.value)
+        if kind is None:
+            return
+        for tgt in node.targets:
+            key = self._target_key(sf, cls, tgt)
+            if key:
+                self.locks[key] = LockInfo(key, kind, sf, node.lineno)
+
+    @staticmethod
+    def _target_key(sf, cls, tgt) -> Optional[str]:
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == 'self':
+            owner = cls or '?'
+            return f'{sf.relpath}::{owner}.{tgt.attr}'
+        if isinstance(tgt, ast.Name):
+            return f'{sf.relpath}::{tgt.id}'
+        return None
+
+    # -- acquisition sites -------------------------------------------------
+
+    def _lock_for_expr(self, sf, cls, expr) -> Optional[LockInfo]:
+        """LockInfo denoted by an expression, or None."""
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name):
+                if expr.value.id == 'self' and cls:
+                    lk = self.locks.get(f'{sf.relpath}::{cls}.{expr.attr}')
+                    if lk:
+                        return lk
+                    # inherited / same-file sibling class attr
+                    hits = [l for k, l in self.locks.items()
+                            if k.startswith(f'{sf.relpath}::')
+                            and k.endswith(f'.{expr.attr}')]
+                    return hits[0] if len(hits) == 1 else None
+                # module attr: trace._rings_lock via imports
+                mod = sf.imports.get(expr.value.id)
+                if mod:
+                    mf = self.index.module_file(mod)
+                    if mf is not None:
+                        return self.locks.get(
+                            f'{mf.relpath}::{expr.attr}')
+        elif isinstance(expr, ast.Name):
+            return self.locks.get(f'{sf.relpath}::{expr.id}')
+        return None
+
+    @staticmethod
+    def _acquire_blocking(call: ast.Call) -> bool:
+        """True when a .acquire(...) call can block forever."""
+        for kw in call.keywords:
+            if kw.arg == 'timeout':
+                return False
+            if kw.arg == 'blocking' and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return False
+        if call.args:
+            a0 = call.args[0]
+            if isinstance(a0, ast.Constant) and a0.value is False:
+                return False               # acquire(False)
+            if len(call.args) > 1:
+                return False               # acquire(True, timeout)
+        return True
+
+    def _cm_acquired_lock(self, sf, cls, call
+                          ) -> Optional[Tuple[LockInfo, bool]]:
+        """`with self._foo():` — when the callee acquires exactly one
+        lock, the with holds it. Returns (lock, blocking)."""
+        targets = self.index.resolve_call(sf, cls, call.func)
+        if len(targets) != 1:
+            return None
+        acqs = self.acquires.get(targets[0].key, [])
+        locks = {a.lock.key for a in acqs}
+        if len(locks) != 1:
+            return None
+        a = acqs[0]
+        return (a.lock, a.blocking)
+
+    def _find_acquires(self):
+        # two passes: direct with/acquire sites first, so the second
+        # pass can resolve `with self._cm():` through the callee table
+        for _pass in (1, 2):
+            for fi in self.index.functions.values():
+                out = self.acquires.setdefault(fi.key, []) \
+                    if _pass == 1 else self.acquires[fi.key]
+                if _pass == 2:
+                    have = {id(a.node) for a in out}
+                for node in self.index.walk_function(fi):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            ce = item.context_expr
+                            lk = self._lock_for_expr(fi.file, fi.cls, ce)
+                            blocking = True
+                            if lk is None and _pass == 2 and \
+                                    isinstance(ce, ast.Call):
+                                got = self._cm_acquired_lock(
+                                    fi.file, fi.cls, ce)
+                                if got:
+                                    lk, blocking = got
+                            if lk is not None:
+                                if _pass == 2 and id(node) in have:
+                                    continue
+                                out.append(Acquire(
+                                    lk, node, fi, blocking, True,
+                                    body=node.body))
+                    elif isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == 'acquire':
+                        lk = self._lock_for_expr(fi.file, fi.cls,
+                                                 node.func.value)
+                        if lk is not None and _pass == 1:
+                            out.append(Acquire(
+                                lk, node, fi,
+                                self._acquire_blocking(node), False))
+
+    # -- reachability over acquisitions -----------------------------------
+
+    def reachable_acquires(self, key) -> Set[str]:
+        """Lock keys acquired by `key` or anything it can call."""
+        if key in self._reach_cache:
+            return self._reach_cache[key]
+        edges = self.index.call_edges()
+        seen_fn = set()
+        out: Set[str] = set()
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            if k in seen_fn:
+                continue
+            seen_fn.add(k)
+            for a in self.acquires.get(k, ()):
+                out.add(a.lock.key)
+            stack.extend(edges.get(k, ()))
+        self._reach_cache[key] = out
+        return out
+
+    def order_edges(self):
+        """{(held, acquired): [(file, line, via)]} — every observed
+        held->acquired pair with an example site."""
+        edges: Dict[Tuple[str, str], List] = {}
+
+        def add(a_key, b_key, file, line, via):
+            if a_key == b_key:
+                return
+            edges.setdefault((a_key, b_key), []).append(
+                (file.relpath, line, via))
+
+        for fi in self.index.functions.values():
+            for acq in self.acquires.get(fi.key, ()):
+                if not acq.via_with:
+                    continue
+                held = acq.lock.key
+                for stmt in acq.body:
+                    for sub in ast.walk(stmt):
+                        # direct nested acquisition
+                        if isinstance(sub, ast.With):
+                            for item in sub.items:
+                                lk = self._lock_for_expr(
+                                    fi.file, fi.cls, item.context_expr)
+                                if lk is not None:
+                                    add(held, lk.key, fi.file,
+                                        sub.lineno, 'nested with')
+                        elif isinstance(sub, ast.Call):
+                            if isinstance(sub.func, ast.Attribute) and \
+                                    sub.func.attr == 'acquire':
+                                lk = self._lock_for_expr(
+                                    fi.file, fi.cls, sub.func.value)
+                                if lk is not None:
+                                    add(held, lk.key, fi.file,
+                                        sub.lineno, 'nested acquire')
+                                    continue
+                            for tgt in self.index.resolve_call(
+                                    fi.file, fi.cls, sub.func):
+                                for lk_key in self.reachable_acquires(
+                                        tgt.key):
+                                    add(held, lk_key, fi.file,
+                                        sub.lineno,
+                                        f'call {tgt.qualname}()')
+        return edges
+
+
+def _cycles(edges):
+    """Simple cycles in the lock graph (as ordered key tuples, each
+    reported once in canonical rotation)."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles = set()
+    out = []
+
+    def dfs(start, node, path, visited):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = tuple(path)
+                rot = min(range(len(cyc)),
+                          key=lambda i: cyc[i:] + cyc[:i])
+                canon = cyc[rot:] + cyc[:rot]
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(canon)
+            elif nxt not in visited and len(path) < 6:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return out
+
+
+_MODEL_CACHE: dict = {}
+
+
+def lock_model(index: FileIndex) -> LockModel:
+    model = _MODEL_CACHE.get(id(index))
+    if model is None or model.index is not index:
+        model = LockModel(index)
+        _MODEL_CACHE.clear()
+        _MODEL_CACHE[id(index)] = model
+    return model
+
+
+class LockOrderRule(LintRule):
+    id = 'lock-order'
+    doc = ('cycles in the lock-acquisition graph (with-nesting + call '
+           'edges) — potential deadlocks')
+
+    def run(self, index: FileIndex):
+        model = lock_model(index)
+        edges = model.order_edges()
+        findings = []
+        for cyc in _cycles(edges):
+            pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+            example = edges[pairs[0]][0]
+            file = index.file(example[0])
+            chain = ' -> '.join(c.split('::', 1)[1] for c in cyc)
+            first = chain.split(' -> ')[0]
+            # example sites (file + how) stay line-free: the finding's
+            # fingerprint must survive unrelated edits moving the code
+            sites = '; '.join(
+                f"{edges[e][0][0]} ({edges[e][0][2]})" for e in pairs
+                if e in edges)
+            findings.append(self.finding(
+                file, example[1],
+                f"lock-order cycle {chain} -> {first} — two threads "
+                f"taking these in opposite order deadlock "
+                f"(sites: {sites})",
+                symbol=cyc[0]))
+        return findings
+
+
+class SignalSafetyRule(LintRule):
+    id = 'signal-safety'
+    doc = ('signal/atexit handlers must not block on a non-reentrant '
+           'lock (no-timeout acquire reachable from a handler)')
+
+    def _handler_roots(self, index: FileIndex):
+        """(FuncInfo, registration description) for every handler
+        passed to signal.signal / atexit.register."""
+        roots = []
+        for sf in index.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                is_sig = dn.endswith('.signal') and \
+                    sf.imports.get(dn.split('.')[0], '').startswith(
+                        'signal')
+                is_atexit = dn.endswith('.register') and \
+                    sf.imports.get(dn.split('.')[0], '') == 'atexit'
+                if not (is_sig or is_atexit):
+                    continue
+                args = node.args
+                handler_expr = args[1] if is_sig and len(args) > 1 else \
+                    (args[0] if is_atexit and args else None)
+                if handler_expr is None:
+                    continue
+                kind = 'signal handler' if is_sig else 'atexit hook'
+                # file only, no line: the registration site lands in
+                # the finding MESSAGE, which must stay line-stable for
+                # the baseline fingerprint
+                where = sf.relpath
+                if isinstance(handler_expr, ast.Call):
+                    # factory: the built handler is lexically inside it
+                    for t in index.resolve_call(sf, None,
+                                                handler_expr.func):
+                        roots.append((t, kind, where))
+                    continue
+                # skip signal.SIG_DFL / SIG_IGN restores
+                dn_h = dotted_name(handler_expr)
+                if dn_h.endswith(('SIG_DFL', 'SIG_IGN')):
+                    continue
+                encl = index.enclosing_function(sf, node)
+                cls = encl.cls if encl is not None else None
+                for t in index.resolve_call(sf, cls, handler_expr):
+                    roots.append((t, kind, where))
+        return roots
+
+    def run(self, index: FileIndex):
+        model = lock_model(index)
+        edges = index.call_edges()
+        findings = []
+        reported = set()
+        for root, kind, where in self._handler_roots(index):
+            seen = set()
+            stack = [root.key]
+            while stack:
+                k = stack.pop()
+                if k in seen:
+                    continue
+                seen.add(k)
+                for acq in model.acquires.get(k, ()):
+                    if not acq.blocking or acq.lock.kind != 'lock':
+                        continue
+                    fi = index.functions[k]
+                    dedup = (k, acq.node.lineno, acq.lock.key)
+                    if dedup in reported:
+                        continue
+                    reported.add(dedup)
+                    findings.append(self.finding(
+                        fi.file, acq.node.lineno,
+                        f"{fi.qualname} acquires non-reentrant lock "
+                        f"{acq.lock.key.split('::', 1)[1]} without a "
+                        f"timeout and is reachable from a {kind} "
+                        f"(registered at {where}) — a signal landing "
+                        f"while the interrupted frame holds it "
+                        f"deadlocks the process",
+                        symbol=fi.qualname))
+                stack.extend(edges.get(k, ()))
+        return findings

@@ -1,0 +1,192 @@
+"""registry-drift: string-keyed contracts stay in sync with their
+registries.
+
+Three contracts, one rule:
+
+- **fault sites**: every ``faults.fire('<site>')`` literal must be a
+  key of ``resilience/faults.py``'s ``_SITES`` dict (parsed from its
+  AST — the code IS the registry; an unregistered site would raise at
+  arm time but only on the run that arms it).
+- **span names**: every ``span('<name>')``/``instant('<name>')``
+  literal must be declared in ``contracts.SPAN_NAMES`` — the
+  attribution bucketing and docs enumerate that set.
+- **telemetry metric names**: every instrumentation-site literal must
+  be ``mxnet_tpu_*`` lowercase_snake, registered under exactly one
+  kind, and consistent with ``contracts.SUBSYSTEM_METRICS``
+  (declared-but-never-recorded, kind mismatch, and
+  undeclared-under-prefix all fail). This subsumes the old
+  check_telemetry_names.py scanner, which is now a thin wrapper over
+  ``scan_metrics``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import contracts
+from ..core import (FileIndex, LintRule, call_name, dotted_name,
+                    str_const)
+
+
+def parse_fault_sites(index: FileIndex,
+                      registry_suffix='resilience/faults.py'
+                      ) -> Optional[Set[str]]:
+    """Keys of the ``_SITES`` dict literal, or None when the registry
+    file is not in the tree (fixture runs pass sites explicitly)."""
+    for sf in index.files_matching(registry_suffix):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id == '_SITES'
+                        for t in node.targets) and \
+                    isinstance(node.value, ast.Dict):
+                return {str_const(k) for k in node.value.keys
+                        if str_const(k)}
+    return None
+
+
+def scan_metrics(index: FileIndex):
+    """(names, errors) over every metric-call literal in the tree —
+    the engine behind check_telemetry_names.py.
+
+    names: {metric name: set of kinds it is recorded under}
+    errors: [(relpath, lineno, name, problem)]
+    """
+    names: Dict[str, Set[str]] = {}
+    errors: List[Tuple[str, int, str, str]] = []
+    for sf in index.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else \
+                (func.id if isinstance(func, ast.Name) else '')
+            if attr not in contracts.KINDS:
+                continue
+            name = str_const(node.args[0])
+            if name is None:
+                continue
+            kind = contracts.KINDS[attr]
+            if not contracts.NAME_RE.match(name):
+                # `value` is the one kind-agnostic verb generic enough
+                # to collide with non-metric APIs — only namespaced
+                # strings are metric sites there; the mutation verbs
+                # (inc/observe/...) are unambiguous and always checked
+                if kind is None and not name.startswith('mxnet_tpu'):
+                    continue
+                errors.append(
+                    (sf.relpath, node.lineno, name,
+                     'not lowercase_snake / not namespaced mxnet_tpu_*'))
+                continue
+            if kind is not None:
+                names.setdefault(name, set()).add(kind)
+    for name, kinds in sorted(names.items()):
+        if len(kinds) > 1:
+            errors.append(
+                ('<registry>', 0, name,
+                 f"registered under multiple kinds: {sorted(kinds)}"))
+    if not index.files_matching('telemetry/metrics.py'):
+        # the subsystem contract describes the mxnet_tpu registry —
+        # declared-but-never-recorded is meaningless for a tree that
+        # does not contain it (fixtures, external packages)
+        return names, errors
+    for prefix, declared in contracts.SUBSYSTEM_METRICS.items():
+        for name, kind in sorted(declared.items()):
+            found = names.get(name)
+            if not found:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"declared for the {prefix}* subsystem but never "
+                     f"recorded by any instrumentation site"))
+            elif kind not in found:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"declared as {kind} but recorded as "
+                     f"{sorted(found)}"))
+        for name in sorted(names):
+            if name.startswith(prefix) and name not in declared:
+                errors.append(
+                    ('<subsystem>', 0, name,
+                     f"new {prefix}* metric not declared in "
+                     f"SUBSYSTEM_METRICS (update the contract + docs)"))
+    return names, errors
+
+
+class RegistryDriftRule(LintRule):
+    id = 'registry-drift'
+    doc = ('faults.fire sites / span names / telemetry metric names '
+           'must match their registry or contract')
+
+    def __init__(self, fault_sites=None, span_names=None,
+                 check_metrics=True):
+        self._fault_sites = fault_sites
+        self.span_names = (frozenset(span_names)
+                           if span_names is not None
+                           else contracts.SPAN_NAMES)
+        self.check_metrics = check_metrics
+
+    def run(self, index: FileIndex):
+        findings = []
+        sites = self._fault_sites
+        if sites is None:
+            sites = parse_fault_sites(index)
+        for sf in index.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                cn = call_name(node)
+                leaf = cn.rsplit('.', 1)[-1]
+                lit = str_const(node.args[0])
+                if lit is None:
+                    continue
+                if leaf == 'fire' and sites is not None and \
+                        self._is_faults_call(sf, node):
+                    if lit not in sites:
+                        findings.append(self.finding(
+                            sf, node.lineno,
+                            f"fault site {lit!r} is not registered in "
+                            f"resilience/faults.py _SITES — arming it "
+                            f"raises at runtime", symbol=lit))
+                elif leaf in ('span', 'instant', 'complete') and \
+                        self._is_trace_call(sf, node):
+                    if lit not in self.span_names:
+                        findings.append(self.finding(
+                            sf, node.lineno,
+                            f"span name {lit!r} is not declared in "
+                            f"tools/mxtpu_lint/contracts.py SPAN_NAMES "
+                            f"— attribution and docs have never heard "
+                            f"of it", symbol=lit))
+        if self.check_metrics:
+            _names, errors = scan_metrics(index)
+            for relpath, lineno, name, problem in errors:
+                sf = index.file(relpath)
+                findings.append(self.finding(
+                    sf, lineno, f"metric {name!r}: {problem}",
+                    symbol=name))
+        return findings
+
+    @staticmethod
+    def _is_faults_call(sf, node) -> bool:
+        """fire(...) / faults.fire(...) / _faults.fire(...)."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            return sf.imports.get('fire', '').endswith('faults.fire') \
+                or sf.relpath.endswith('faults.py')
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            mod = sf.imports.get(func.value.id, func.value.id)
+            return mod.endswith('faults') or 'faults' in func.value.id
+        return False
+
+    @staticmethod
+    def _is_trace_call(sf, node) -> bool:
+        """span(...) / _trace.span(...) / trace.instant(...)."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            return sf.imports.get(func.id, '').endswith(
+                ('trace.span', 'trace.instant', 'trace.complete')) \
+                or sf.relpath.endswith('telemetry/trace.py')
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            mod = sf.imports.get(func.value.id, func.value.id)
+            return mod.endswith('trace') or 'trace' in func.value.id
+        return False
