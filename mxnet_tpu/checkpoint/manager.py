@@ -403,13 +403,22 @@ class CheckpointManager:
             if stage == 0 and (getattr(tr, '_zero_active', False)
                                or getattr(tr, 'zero', False)):
                 stage = 1
-            meta.setdefault('optimizer_state_layout', {
+            layout = {
                 'format': 'gathered-host',
                 'zero1': stage >= 1,
                 'stage': stage,
                 'dp': int(getattr(tr, '_zero_dp', 0)
                           or getattr(tr, '_dp_size', 1)),
-            })
+            }
+            comp = getattr(tr, 'compression', None)
+            if comp:
+                # error-feedback residuals ride the states payload;
+                # record the codec they were accumulated under so
+                # cross-config resumes (restore with compression off ->
+                # residuals deterministically reseed to zero) are
+                # auditable from the manifest alone
+                layout['compression'] = dict(comp)
+            meta.setdefault('optimizer_state_layout', layout)
         return {'step': int(step), 'arrays': arrays, 'blobs': blobs,
                 'rng': rng, 'metadata': meta}
 

@@ -300,6 +300,34 @@ register('MXTPU_REPLICA_TIMEOUT_SECONDS', float, 10.0,
          'file_get / inventory / commit / delete). Bounds how long a '
          'dead peer can hold a replication worker or a replica-restore '
          'fetch — never the training thread.')
+register('MXTPU_COMPRESSION', str, '',
+         "Error-feedback gradient compression codec of the GSPMD "
+         "sharded step when no explicit compression_params are given: "
+         "'' or 'none' (off, the default), 'fp16' (truncate, 2x wire "
+         "shrink), 'int8' (per-block scale, ~3.9x) or '2bit' (the "
+         "reference kvstore's sign+threshold quantizer, ~15x). The "
+         "quantization residual is carried per-param as sharded "
+         "optimizer-side state, so the error is re-offered next step "
+         "instead of lost.")
+register('MXTPU_COMPRESSION_THRESHOLD', float, 0.5,
+         "2-bit gradient compression threshold (the reference's "
+         "pos_threshold/neg_threshold magnitude): values quantize to "
+         "{-t*s, 0, +t*s} against the per-block scale s (s=1 when the "
+         "block knob is 0 — absolute-threshold reference semantics).")
+register('MXTPU_COMPRESSION_BLOCK', int, 256,
+         'Per-block scale granularity (elements along the last dim) of '
+         'the int8/2bit gradient codecs. 0: one per-tensor scale '
+         '(2bit then uses the absolute threshold with no wire '
+         'overhead). Each block adds one fp32 scale to the encoded '
+         'payload.')
+register('MXTPU_HIERARCHICAL_DP', int, 0,
+         'Hierarchy-aware decomposition of the dp axis into (cross-'
+         'host, intra-host) sub-axes: ZeRO shards and param '
+         'all-gathers then stay on the fast intra-host ICI hop and '
+         'only the (compressible) gradient exchange crosses the slow '
+         'DCN hop. 0 (default): auto-detect host groups from the '
+         'device->process topology; 1: force flat (single hop); N>=2: '
+         'force N equal host groups (CPU simulation / drills).')
 register('MXTPU_SCRUB_SECONDS', float, 300.0,
          'Background checkpoint scrubber cadence: every this many '
          'seconds the scrubber re-hashes one pass over the committed '

@@ -94,17 +94,26 @@ class Trainer:
         return self._compression_params is not None and \
             self._compression_params.get('type', '2bit') != 'none'
 
+    def _local_compression(self):
+        """The trainer-owned error-feedback compressor for the paths
+        that never pass a kvstore push (kvstore=None, and the
+        GSPMD-mesh / single-copy path where the push is skipped) —
+        routed for real instead of rejected (ISSUE 12). Residuals key
+        by parameter index; a ``set_states_bytes`` restore resets them
+        (deterministic reseed — the old error state no longer describes
+        the rewound trajectory)."""
+        comp = getattr(self, '_local_gc', None)
+        if comp is None:
+            from ..kvstore.gradient_compression import GradientCompression
+            p = self._compression_params or {}
+            comp = self._local_gc = GradientCompression(
+                p.get('type', '2bit'), p.get('threshold', 0.5),
+                p.get('block_size', 0))
+        return comp
+
     def _init_kvstore(self):
         """Ref: trainer.py:174."""
         if self._kvstore_type is None or self._kvstore_type is False:
-            if self._compression_requested():
-                raise MXNetError(
-                    "gradient compression requires a kvstore: with "
-                    "kvstore=None the gradients never pass a push where "
-                    "compress_decompress could run, so the setting would "
-                    "be silently ignored. Create the Trainer with "
-                    "kvstore='device' (multi-copy) or drop "
-                    "compression_params.")
             self._kvstore = None
             if self._update_on_kvstore is None:
                 self._update_on_kvstore = False
@@ -298,19 +307,16 @@ class Trainer:
                 if self._compression_requested() and \
                         not self._update_on_kvstore:
                     # update_on_kvstore pushes in _update (compression
-                    # applies there); THIS path skips the push entirely
-                    # the GSPMD / single-copy path never pushes, so the
-                    # 2bit quantization would be silently skipped —
-                    # surface that instead (ISSUE 4 satellite)
-                    raise MXNetError(
-                        "gradient compression is configured but parameter "
-                        f"'{param.name}' has a single gradient copy and "
-                        "one worker: the kvstore push that applies "
-                        "compression is skipped on this (GSPMD mesh / "
-                        "single-device) path, so the setting would be "
-                        "silently ignored. Drop compression_params or "
-                        "train with per-context gradient copies "
-                        "(multi-copy kvstore) / dist_sync workers.")
+                    # applies there); THIS path skips the push entirely,
+                    # so apply the SAME error-feedback codec in place —
+                    # the semantics of a push through a compressing
+                    # kvstore, minus the no-op self-reduce (ISSUE 12:
+                    # routed for real instead of raising)
+                    comp = self._kvstore._compression \
+                        if getattr(self._kvstore, '_compression', None) \
+                        is not None else self._local_compression()
+                    grads[0]._data = comp.compress_decompress(
+                        grads[0], i)._data
                 continue
             if self._update_on_kvstore:
                 continue  # push+pull happens in _update via kvstore updater
@@ -347,6 +353,8 @@ class Trainer:
             return
         import jax
         from ..kvstore.kvstore import _reduce
+        compress_here = self._kvstore is None and \
+            self._compression_requested()
         items = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
@@ -357,6 +365,11 @@ class Trainer:
             # the reduction happens here so no context's contribution drops
             g = grads[0] if (self._kvstore is not None or len(grads) == 1) \
                 else _reduce(grads)
+            if compress_here:
+                # kvstore=None: no push exists, so the error-feedback
+                # codec applies to the merged gradient right here
+                # (ISSUE 12: routed for real instead of raising)
+                g = self._local_compression().compress_decompress(g, i)
             items.append((i, param, g, datas))
         # one jitted multi-tensor apply for ALL parameters (the analog of
         # the reference's fused preloaded_multi_sgd/multi_lamb update ops,
@@ -845,6 +858,14 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._updater.set_states(states)
+        # a restore rewinds the trajectory: carried error-feedback
+        # residuals no longer describe it — deterministic zero reseed
+        # (the kvstore compressor keys residuals the same way)
+        if getattr(self, '_local_gc', None) is not None:
+            self._local_gc.reset()
+        if self._kvstore is not None and \
+                getattr(self._kvstore, '_compression', None) is not None:
+            self._kvstore._compression.reset()
         if hasattr(self._updater, 'optimizer'):
             self._optimizer = self._updater.optimizer
             # re-attach live params: __getstate__ drops param_dict, so

@@ -1,9 +1,17 @@
-"""2-bit gradient compression with error-feedback residual.
+"""Gradient compression with error-feedback residual (kvstore path).
 
-Ref: src/kvstore/gradient_compression.h:52-121 — quantize to {-threshold, 0,
-+threshold} with residual accumulation. On TPU this runs as a fused XLA
-elementwise pass over the gradient; it models exactly the reference's math
-(compute_expected_2bit_quantization in tests/python/unittest/test_kvstore.py).
+Ref: src/kvstore/gradient_compression.h:52-121 — quantize to {-threshold,
+0, +threshold} with residual accumulation. On TPU this runs as a fused
+XLA elementwise pass over the gradient; it models exactly the
+reference's math (compute_expected_2bit_quantization in
+tests/python/unittest/test_kvstore.py).
+
+The codecs themselves live in ``parallel/compression.py`` and are
+SHARED with the GSPMD sharded-step epilogue
+(``ShardedTrainStep(compression_params=...)``), so
+``kvstore.set_gradient_compression`` routes to the same quantizers:
+``2bit`` (absolute threshold here — ``block_size=0`` default preserves
+the reference semantics), plus ``fp16`` and ``int8`` (per-block scale).
 """
 from __future__ import annotations
 
@@ -11,34 +19,65 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..parallel import compression as _codecs
 
 
 class GradientCompression:
-    def __init__(self, ctype='2bit', threshold=0.5):
-        if ctype not in ('none', '2bit'):
-            # explicit rejection, not a bare assert: user scripts pass
-            # e.g. type='fp16' (a later reference addition) and must get
-            # an actionable error instead of an AssertionError
-            raise MXNetError(
-                f"gradient compression type {ctype!r} is not supported "
-                f"(supported: 'none', '2bit'). The reference's fp16 "
-                f"compression has no TPU-path implementation here.")
-        self.type = ctype
-        self.threshold = float(threshold)
+    def __init__(self, ctype='2bit', threshold=0.5, block_size=None):
+        # ONE validator (codec names, threshold > 0, block >= 0):
+        # parallel/compression.resolve — user scripts passing arbitrary
+        # strings or a negative block get an actionable MXNetError here
+        # instead of an opaque reshape failure mid-training.
+        # block_size=0 (the kvstore default) keeps the reference's
+        # ABSOLUTE-threshold 2bit semantics / per-tensor int8 scale;
+        # pass a positive block for the per-block-scale variants the
+        # sharded step uses.
+        spec = _codecs.resolve({'type': ctype, 'threshold': threshold,
+                                'block_size': int(block_size or 0)})
+        if spec is None:
+            self.type, self.threshold, self.block = 'none', \
+                float(threshold), 0
+        else:
+            self.type = spec['type']
+            self.threshold = spec['threshold']
+            self.block = spec['block']
         self._residual = {}
 
     def get_params(self):
-        return {'type': self.type, 'threshold': self.threshold}
+        return {'type': self.type, 'threshold': self.threshold,
+                'block_size': self.block}
+
+    def wire_bytes(self, shape):
+        """Analytic encoded bytes of one pushed gradient (the
+        ``mxnet_tpu_comm_compressed_bytes_total`` unit)."""
+        return _codecs.wire_bytes(tuple(shape), self.type, self.block)
 
     def compress_decompress(self, grad: NDArray, key) -> NDArray:
+        """Error-feedback round trip of one push: quantize
+        ``grad + residual[key]``, carry the quantization error forward,
+        return the decoded value the pull side would see."""
         if self.type == 'none':
             return grad
-        r = self._residual.get(key)
         g = grad._data.astype(jnp.float32)
+        r = self._residual.get(key)
         if r is None:
             r = jnp.zeros_like(g)
         acc = r + g
-        t = self.threshold
-        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
-        self._residual[key] = acc - q
+        q = _codecs.encode_decode(acc, self.type, self.threshold,
+                                  self.block)
+        # residual writeback GATED on finiteness (on device, no host
+        # sync): a transient Inf/NaN gradient propagates through the
+        # decoded value — so the caller's guard / AMP loss scaler still
+        # sees and skips it — but must never outlive that push in the
+        # carried error state, or every later step decodes NaN and
+        # training wedges permanently (same contract as the pjit step's
+        # where-gated residual writeback).
+        self._residual[key] = jnp.where(jnp.all(jnp.isfinite(acc)),
+                                        acc - q, r)
         return NDArray(q.astype(grad._data.dtype))
+
+    def reset(self):
+        """Drop the carried residuals (deterministic reseed — e.g.
+        after a checkpoint restore rewinds the weights, the old error
+        state no longer describes the current trajectory)."""
+        self._residual.clear()

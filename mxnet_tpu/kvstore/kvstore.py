@@ -131,10 +131,15 @@ class KVStore(KVStoreBase):
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
+        """Route pushes through the shared codec set
+        (parallel/compression.py): '2bit' (reference absolute-threshold
+        semantics by default), 'fp16', 'int8', 'none'. ``block_size``
+        opts into per-block scales (the sharded-step default)."""
         from .gradient_compression import GradientCompression
         ctype = compression_params.get('type', '2bit')
         threshold = compression_params.get('threshold', 0.5)
-        self._compression = GradientCompression(ctype, threshold)
+        block = compression_params.get('block_size', 0)
+        self._compression = GradientCompression(ctype, threshold, block)
 
     # --- distributed attributes --------------------------------------------
     @property
@@ -206,6 +211,12 @@ class DistSync(KVStore):
             if _telem['on']:
                 _telem_push(k, vlist)
             merged = _reduce(vlist)
+            if self._compression is not None:
+                # compress BEFORE the cross-worker exchange — the
+                # encoded push payload is what crosses DCN (ref:
+                # kvstore_dist.h compresses the worker->server push;
+                # the pull side stays full precision)
+                merged = self._compression.compress_decompress(merged, k)
             if nproc > 1:
                 from jax.experimental import multihost_utils
                 summed = multihost_utils.process_allgather(merged._data)

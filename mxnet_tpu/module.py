@@ -296,6 +296,18 @@ class Module(BaseModule):
                  compression_params=None):
         super().__init__(logger)
         self._symbol = symbol
+        # Module's update path never passes a kvstore push, so the
+        # error-feedback codec applies to the summed gradient in
+        # update() — routed for real, same contract as gluon.Trainer's
+        # no-push paths (ISSUE 12; an unknown ctype raises here)
+        self._compression = None
+        if compression_params is not None and \
+                compression_params.get('type', '2bit') != 'none':
+            from .kvstore.gradient_compression import GradientCompression
+            self._compression = GradientCompression(
+                compression_params.get('type', '2bit'),
+                compression_params.get('threshold', 0.5),
+                compression_params.get('block_size', 0))
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
         if context is None:
@@ -488,6 +500,8 @@ class Module(BaseModule):
             total = grads[0]
             for g in grads[1:]:
                 total = total + g
+            if self._compression is not None:
+                total = self._compression.compress_decompress(total, name)
             weight = self._arg_params[name]
             self._updater(idx, total, weight)
             for e in self._execs:

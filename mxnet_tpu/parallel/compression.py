@@ -1,0 +1,166 @@
+"""Error-feedback gradient compression codecs (ISSUE 12).
+
+Pure-jnp quantize→dequantize pairs usable both INSIDE the compiled pjit
+step (``parallel/step.py``'s reduce-scatter epilogue) and eagerly on the
+kvstore push path (``kvstore/gradient_compression.py``). The codec
+contract is the reference's 2-bit kvstore semantics
+(src/kvstore/gradient_compression.h: quantize to {-t, 0, +t} with the
+quantization error carried forward) generalized to three wire formats:
+
+- ``fp16``  — truncate fp32 → fp16 (2 bytes/elem, 2x wire shrink);
+- ``int8``  — per-block max-abs scale, round to [-127, 127]
+  (1 byte/elem + one fp32 scale per block, ~3.9x);
+- ``2bit``  — sign+threshold: quantize to {-t*s, 0, +t*s} where ``s``
+  is the per-block max-abs scale (or 1.0 with ``block=0`` — the
+  reference's absolute-threshold semantics) — 2 bits/elem + one fp32
+  scale per block, ~15x.
+
+Error feedback (Lin et al., Deep Gradient Compression; Karimireddy et
+al., Error Feedback Fixes SignSGD) lives in the CALLERS: they compute
+``dec = encode_decode(grad + residual)`` and carry
+``residual = grad + residual - dec`` forward, so the quantization error
+is re-offered next step instead of lost. This module is stateless.
+
+NaN/Inf inputs PROPAGATE through every codec: a jnp comparison against
+a NaN is False, so a naive threshold quantizer would silently map a
+poisoned gradient to 0 and hide it from the non-finite guard — instead
+``encode_decode`` re-injects non-finite inputs into the decoded output
+so the guard (which reduces over the DECODED grads) still trips.
+
+The collectives themselves are emitted by XLA from sharding
+constraints, so the wire accounting is analytic (``wire_bytes``) — the
+same methodology as the ``mxnet_tpu_comm_*`` ring accounting.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+CODECS = ('none', 'fp16', 'int8', '2bit')
+
+#: analytic encoded payload size, bits per element (excluding per-block
+#: scales — those are accounted separately by wire_bytes)
+BITS_PER_ELEM = {'fp16': 16, 'int8': 8, '2bit': 2}
+
+
+def resolve(compression_params, default_type=None):
+    """Validate ``compression_params`` (a dict with ``type`` and
+    optional ``threshold``/``block_size``) into a plain
+    ``{'type', 'threshold', 'block'}`` spec, or None when compression
+    is off. ``compression_params=None`` falls back to the
+    ``MXTPU_COMPRESSION`` / ``MXTPU_COMPRESSION_THRESHOLD`` /
+    ``MXTPU_COMPRESSION_BLOCK`` knobs (``default_type`` overrides the
+    first). Unknown ctype strings raise an actionable MXNetError."""
+    from .. import config as _config
+    if compression_params is None:
+        ctype = default_type if default_type is not None \
+            else _config.get('MXTPU_COMPRESSION')
+        if not ctype or ctype == 'none':
+            return None
+        compression_params = {'type': ctype}
+    ctype = compression_params.get('type', '2bit')
+    if ctype not in CODECS:
+        raise MXNetError(
+            f"gradient compression type {ctype!r} is not supported "
+            f"(supported: {', '.join(repr(c) for c in CODECS)}). "
+            f"'fp16' truncates to half precision, 'int8' rounds against "
+            f"a per-block max-abs scale, '2bit' is the reference "
+            f"kvstore's sign+threshold quantizer.")
+    if ctype == 'none':
+        return None
+    threshold = float(compression_params.get(
+        'threshold', _config.get('MXTPU_COMPRESSION_THRESHOLD')))
+    block = int(compression_params.get(
+        'block_size', _config.get('MXTPU_COMPRESSION_BLOCK')))
+    if threshold <= 0:
+        raise MXNetError(
+            f"gradient compression threshold must be > 0, got "
+            f"{threshold!r}")
+    if block < 0:
+        raise MXNetError(
+            f"gradient compression block_size must be >= 0 "
+            f"(0 = one per-tensor scale), got {block!r}")
+    return {'type': ctype, 'threshold': threshold, 'block': block}
+
+
+def _block_scale(x, block):
+    """Per-block max-abs scale of ``x`` broadcast back to x's shape.
+    Blocks tile the LAST dim when it divides evenly; otherwise one
+    per-tensor scale (keeps the codec shape-agnostic — ragged tails
+    would force gather/pad inside the compiled step). ``block=0`` is
+    the explicit per-tensor mode. Zero blocks get scale 1.0 so the
+    quantizer never divides by zero."""
+    if block and x.ndim and x.shape[-1] % block == 0 and \
+            x.shape[-1] >= block:
+        nb = x.shape[-1] // block
+        v = x.reshape(x.shape[:-1] + (nb, block))
+        s = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
+        s = jnp.where(s > 0, s, 1.0)
+        return jnp.broadcast_to(s, v.shape).reshape(x.shape)
+    s = jnp.max(jnp.abs(x)) if x.size else jnp.float32(1.0)
+    return jnp.where(s > 0, s, 1.0)
+
+
+def n_scales(shape, block):
+    """How many per-block fp32 scales the encoded form of a tensor with
+    ``shape`` carries (the wire-overhead half of ``wire_bytes``)."""
+    if not shape:
+        return 1
+    last = shape[-1]
+    size = 1
+    for d in shape:
+        size *= d
+    if block and last % block == 0 and last >= block:
+        return size // block
+    return 1
+
+
+def encode_decode(x, ctype, threshold=0.5, block=256):
+    """In-graph quantize→dequantize round trip: the fp32 value the far
+    end of the compressed exchange would decode. Pure jnp (traceable
+    inside pjit; no env/config reads — jit-purity rule). Non-finite
+    inputs propagate to the output (see module docstring)."""
+    x = x.astype(jnp.float32)
+    if ctype == 'fp16':
+        # fp16 truncation propagates NaN/Inf natively (overflow -> inf)
+        return x.astype(jnp.float16).astype(jnp.float32)
+    if ctype == 'int8':
+        s = _block_scale(x, block) / 127.0
+        q = jnp.clip(jnp.round(x / s), -127.0, 127.0)
+        dec = q * s
+    elif ctype == '2bit':
+        # reference semantics: {-t, 0, +t} against the (per-block
+        # scaled) threshold; block=0 -> s=1.0 -> the kvstore's absolute
+        # threshold (test_kvstore.py compute_expected_2bit_quantization)
+        s = _block_scale(x, block) if block else jnp.float32(1.0)
+        t = threshold * s
+        dec = jnp.where(x >= t, t, jnp.where(x <= -t, -t, 0.0))
+    else:
+        raise MXNetError(f"encode_decode: unknown codec {ctype!r}")
+    # comparisons against NaN are all False -> a poisoned gradient
+    # would silently decode to 0; re-inject so the guard sees it
+    return jnp.where(jnp.isfinite(x), dec, x)
+
+
+def wire_bytes(shape, ctype, block=256):
+    """Analytic encoded bytes of one tensor on the wire: payload bits
+    plus one fp32 scale per block (fp16 carries none). The uncompressed
+    reference is ``4 * n`` fp32 bytes."""
+    size = 1
+    for d in tuple(shape):
+        size *= d
+    if ctype == 'none' or not ctype:
+        return 4 * size
+    bits = BITS_PER_ELEM[ctype]
+    payload = (size * bits + 7) // 8
+    scales = 0 if ctype == 'fp16' else 4 * n_scales(tuple(shape), block)
+    if ctype == '2bit' and not block:
+        scales = 0          # absolute threshold: no scales on the wire
+    return payload + scales
+
+
+def compression_ratio(shape, ctype, block=256):
+    """fp32 bytes / encoded bytes for one tensor (>= 1.0)."""
+    return wire_bytes(shape, 'none') / max(1, wire_bytes(
+        shape, ctype, block))

@@ -283,6 +283,58 @@ def num_workers():
     return jax.process_count()
 
 
+def host_topology(devices):
+    """Group ``devices`` (in order) into per-host runs by their owning
+    process: ``[(process_index, [device, ...]), ...]``. This is the
+    hierarchy query the compressed-collective path builds its
+    (cross-host, intra-host) dp decomposition from — the same
+    host-level world the elastic membership layer heartbeats over (one
+    membership rank per jax process). Contiguous runs only: a device
+    order that interleaves processes yields more groups than processes,
+    which ``dp_host_split`` treats as "no clean hierarchy"."""
+    groups = []
+    for d in devices:
+        p = getattr(d, 'process_index', 0)
+        if groups and groups[-1][0] == p:
+            groups[-1][1].append(d)
+        else:
+            groups.append((p, [d]))
+    return groups
+
+
+def dp_host_split(devices, force=None):
+    """(n_hosts, devices_per_host) decomposition of a dp-axis device
+    run, or ``(1, len(devices))`` when no clean hierarchy exists.
+
+    ``force`` (or the ``MXTPU_HIERARCHICAL_DP`` knob when None):
+    0 auto-detects from the device->process topology via
+    ``host_topology``; 1 forces flat; N>=2 forces N equal contiguous
+    groups (CPU simulation — single-process meshes have no real host
+    boundary to discover). Auto-detection requires equal-size
+    contiguous per-process runs; anything else falls back flat rather
+    than build a lopsided hierarchy."""
+    from .. import config as _config
+    n = len(devices)
+    if force is None:
+        force = int(_config.get('MXTPU_HIERARCHICAL_DP') or 0)
+    force = int(force)
+    if force == 1 or n <= 1:
+        return 1, n
+    if force >= 2:
+        if n % force != 0:
+            raise MXNetError(
+                f"MXTPU_HIERARCHICAL_DP={force}: the dp axis has {n} "
+                f"devices, not divisible into {force} equal host "
+                f"groups — pick a divisor of {n} or 0 (auto).")
+        return force, n // force
+    groups = host_topology(devices)
+    sizes = {len(ds) for _p, ds in groups}
+    procs = {p for p, _ds in groups}
+    if len(groups) <= 1 or len(sizes) != 1 or len(procs) != len(groups):
+        return 1, n
+    return len(groups), n // len(groups)
+
+
 # ---------------------------------------------------------------------------
 # elastic membership side channel
 # ---------------------------------------------------------------------------

@@ -1,0 +1,93 @@
+"""bench.py driver-artifact JSON contract (ISSUE 12 satellite — the
+BENCH_r05 leak): tunnel state rides ONLY in the "probe" field, earlier
+measurement-attempt failures in "attempts_failed", and top-level
+"error" appears exclusively on the no-metric-at-all fallback line.
+
+The parent orchestration is driven with a stubbed ``_run_child`` so no
+subprocess (and no jax backend) is touched — these are contract tests
+on the emitted JSON line, not benchmarks."""
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, 'bench.py')
+    spec = importlib.util.spec_from_file_location('bench_under_test', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.time, 'sleep', lambda *_a: None)
+    monkeypatch.setattr(sys, 'argv', ['bench.py'])
+    return mod
+
+
+def _emitted_line(mod, capsys):
+    out = capsys.readouterr().out
+    lines = [l for l in out.strip().splitlines() if l.startswith('{')]
+    assert lines, f"no JSON line emitted: {out!r}"
+    return json.loads(lines[-1])
+
+
+SMOKE = {'metric': 'bert_smoke_samples_per_sec_per_chip', 'value': 16.6,
+         'unit': 'samples/sec/chip', 'vs_baseline': 0.0, 'backend': 'cpu'}
+
+
+def test_wedged_probe_never_leaks_into_top_level_error(bench, capsys,
+                                                       monkeypatch):
+    """The BENCH_r05 regression: probe times out (wedged tunnel), the
+    CPU smoke still succeeds — the valid metric line must carry the
+    tunnel state in "probe" and NO top-level "error"."""
+    def fake_run_child(mode, timeout):
+        if mode == 'probe':
+            return None, f"timeout after {timeout:.0f}s (mode=probe)"
+        assert mode == 'cpu'
+        return dict(SMOKE), None
+    monkeypatch.setattr(bench, '_run_child', fake_run_child)
+    bench.main()
+    doc = _emitted_line(bench, capsys)
+    assert doc['metric'] == SMOKE['metric']
+    assert 'error' not in doc, doc
+    assert 'attempts_failed' not in doc          # no MEASUREMENT failed
+    assert doc['probe']['state'] == 'wedged'
+    assert doc['probe']['attempts'] == 2         # one retry with backoff
+    assert 'mode=probe' in doc['probe']['error']
+
+
+def test_accel_attempt_failure_rides_attempts_failed(bench, capsys,
+                                                     monkeypatch):
+    """Probe sees an accelerator, the accel measurement child dies, the
+    CPU smoke lands: the failure is attempt state, not an error of the
+    valid smoke line."""
+    def fake_run_child(mode, timeout):
+        if mode == 'probe':
+            return {'probe': 'ok', 'platform': 'tpu',
+                    'device_kind': 'v5e', 'n_devices': 4}, None
+        if mode == 'auto':
+            return None, f"timeout after {timeout:.0f}s (mode=auto)"
+        return dict(SMOKE), None
+    monkeypatch.setattr(bench, '_run_child', fake_run_child)
+    bench.main()
+    doc = _emitted_line(bench, capsys)
+    assert 'error' not in doc, doc
+    assert doc['probe']['state'] == 'ok'
+    assert doc['attempts_failed'] == ['timeout after 540s (mode=auto)']
+
+
+def test_total_failure_fallback_carries_error(bench, capsys, monkeypatch):
+    """Only when NO metric line could be produced does top-level
+    "error" appear — and it names the measurement failures, with probe
+    state still separate."""
+    def fake_run_child(mode, timeout):
+        return None, f"rc=1 (mode={mode}): boom"
+    monkeypatch.setattr(bench, '_run_child', fake_run_child)
+    bench.main()
+    doc = _emitted_line(bench, capsys)
+    assert doc['value'] == 0.0 and doc['backend'] == 'none'
+    assert 'mode=cpu' in doc['error']
+    assert 'mode=probe' not in doc['error']      # probe stays in "probe"
+    assert doc['probe']['state'] == 'wedged'

@@ -93,6 +93,15 @@ SUBSYSTEM_METRICS = {
         # persistent params (compute dtype) held by ONE device — the
         # ZeRO-3 1/dp param residency is auditable against it
         'mxnet_tpu_comm_param_bytes_per_device': 'gauge',
+        # error-feedback gradient compression (ISSUE 12): encoded bytes
+        # the compressed exchange actually carries per step (by codec +
+        # hop axis — under the hierarchical decomposition that is the
+        # cross-host DCN hop, whose collective_bytes entries already
+        # count the encoded size), the per-device residual state the
+        # error feedback persists, and the raw/encoded wire ratio
+        'mxnet_tpu_comm_compressed_bytes_total': 'counter',
+        'mxnet_tpu_comm_residual_bytes_per_device': 'gauge',
+        'mxnet_tpu_comm_compression_ratio': 'gauge',
     },
     'mxnet_tpu_elastic_': {
         # elastic multi-host training (membership side channel +
@@ -171,6 +180,10 @@ SPAN_NAMES = frozenset({
     'comm.allreduce', 'comm.broadcast', 'comm.all_gather',
     'comm.reduce_scatter', 'comm.all_reduce', 'comm.state_scatter',
     'comm.param_scatter',
+    # error-feedback gradient compression: per-step instants carrying
+    # the encoded (compress) and decoded-equivalent (decompress) bytes
+    # of the cross-host gradient exchange, with codec + hop labels
+    'comm.compress', 'comm.decompress',
     # optimizer
     'optimizer.update', 'optimizer.fused', 'optimizer.state_init',
     # checkpointing
