@@ -489,6 +489,80 @@ def _attribution_report(step, model, run_step, flops, peak_total,
     return rep
 
 
+def _fleet_report(run_step, steps=6):
+    """Endpoint-armed vs disarmed step-time A/B (ISSUE 13): the same
+    step timed with everything observability off, then with telemetry +
+    tracing armed, the /metrics //healthz endpoint up AND a scraper
+    hammering it concurrently — plus the wire size of one heartbeat
+    telemetry snapshot. The PERF_NOTES "what does watching cost" row."""
+    import threading
+    import urllib.request
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.base import telem_flags
+    from mxnet_tpu.telemetry import fleet, server, trace
+
+    was_telem, was_trace = telem_flags['on'], trace.enabled()
+
+    def timed(n):
+        t0 = time.time()
+        for _ in range(n):
+            run_step()
+        return (time.time() - t0) / n * 1e3
+
+    srv = None
+    stop = threading.Event()
+    scrapes = [0]
+    t = None
+    try:
+        telemetry.disable()
+        trace.disable()
+        run_step()                               # settle / recompile
+        disarmed_ms = timed(steps)
+        telemetry.enable()
+        trace.enable()
+        srv = server.TelemetryServer(port=0)
+
+        def _scrape():
+            base = f'http://127.0.0.1:{srv.port}'
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(base + '/metrics',
+                                           timeout=2).read()
+                    urllib.request.urlopen(base + '/healthz',
+                                           timeout=2).read()
+                    scrapes[0] += 1
+                except Exception:
+                    pass
+                stop.wait(0.05)
+
+        t = threading.Thread(target=_scrape, daemon=True)
+        t.start()
+        run_step()                               # settle under arming
+        armed_ms = timed(steps)
+        snap_bytes = fleet.snapshot_bytes()
+    finally:
+        # a mid-A/B failure must not leave the child's telemetry/trace
+        # disarmed (the atexit flight dump would be empty) or leak the
+        # scraper + server for the rest of the process
+        stop.set()
+        if t is not None:
+            t.join(timeout=2)
+        if srv is not None:
+            srv.stop()
+        (telemetry.enable if was_telem else telemetry.disable)()
+        (trace.enable if was_trace else trace.disable)()
+    return {
+        'steps': steps,
+        'step_ms_disarmed': round(disarmed_ms, 2),
+        'step_ms_armed': round(armed_ms, 2),
+        'overhead_pct': round(
+            (armed_ms - disarmed_ms) / disarmed_ms * 100.0, 2)
+        if disarmed_ms else None,
+        'snapshot_bytes_per_beat': snap_bytes,
+        'scrapes_during_armed_window': scrapes[0],
+    }
+
+
 # ---------------------------------------------------------------------------
 # measurement child
 # ---------------------------------------------------------------------------
@@ -685,6 +759,16 @@ def _child(mode: str) -> None:
     except Exception as e:
         out["attribution"] = {"error": repr(e)[:300]}
         _log(f"attribution report failed: {e!r}")
+    print(json.dumps(out), flush=True)
+    # fleet observability overhead A/B (ISSUE 13): endpoint armed +
+    # scraped vs everything disarmed, on the same compiled step
+    try:
+        out["fleet"] = _fleet_report(
+            lambda: float(step(inputs, [labels, nsp]).asnumpy()))
+        _log(f"fleet report: {out['fleet']}")
+    except Exception as e:
+        out["fleet"] = {"error": repr(e)[:300]}
+        _log(f"fleet report failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 

@@ -15,10 +15,11 @@ section, and ``tools/check_checkpoint_manifest.py``.
 from .manifest import (CorruptCheckpointError, atomic_write_bytes,
                        committed_steps, read_manifest, step_dir_name,
                        validate_step_dir)
-from .manager import CheckpointManager, RestoredCheckpoint
+from .manager import (CheckpointManager, RestoredCheckpoint,
+                      last_committed_step)
 from .replica import ReplicaManager, ReplicaPeer
 
 __all__ = ['CheckpointManager', 'RestoredCheckpoint', 'ReplicaManager',
            'ReplicaPeer', 'CorruptCheckpointError', 'atomic_write_bytes',
-           'committed_steps', 'read_manifest', 'step_dir_name',
-           'validate_step_dir']
+           'committed_steps', 'last_committed_step', 'read_manifest',
+           'step_dir_name', 'validate_step_dir']

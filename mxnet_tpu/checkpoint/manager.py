@@ -30,6 +30,7 @@ import signal as _signal
 import threading
 import time as _time
 import warnings
+import weakref
 from typing import Any, Dict, Optional
 
 import numpy as onp
@@ -42,7 +43,31 @@ from ..resilience.retry import retry_call
 from . import manifest as mf
 from .manifest import CorruptCheckpointError
 
-__all__ = ['CheckpointManager', 'RestoredCheckpoint', 'CorruptCheckpointError']
+__all__ = ['CheckpointManager', 'RestoredCheckpoint',
+           'CorruptCheckpointError', 'last_committed_step']
+
+# every live manager, weakly: the /healthz endpoint reports the newest
+# committed step without holding a reference into any training loop
+_live_managers: 'weakref.WeakSet' = weakref.WeakSet()
+
+
+def _register_manager(mgr) -> None:
+    _live_managers.add(mgr)
+
+
+def last_committed_step() -> Optional[int]:
+    """Newest committed step across every live CheckpointManager in
+    this process (the /healthz "can this rank resume, and from where"
+    answer). None when no manager exists or nothing is committed."""
+    best = None
+    for mgr in list(_live_managers):
+        try:
+            s = mgr.latest_step()
+        except Exception:
+            continue
+        if s is not None and (best is None or s > best):
+            best = s
+    return best
 
 # test-only fault-injection points (tests/test_checkpoint.py): name -> fn(path)
 #   'after_arrays'  — payload files written, manifest not yet
@@ -206,6 +231,7 @@ class CheckpointManager:
         # or a half-finished same-step re-save swap (recovered) behind;
         # nothing of ours is in flight yet, so pid-reuse leftovers go too
         self._recover_and_sweep(sweep_own=True)
+        _register_manager(self)
         # peer replication (ISSUE 10): auto-attached when
         # MXTPU_CHECKPOINT_REPLICAS > 0 and an elastic membership world
         # is running (pass replication=False to force it off, or attach

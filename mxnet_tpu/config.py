@@ -212,9 +212,15 @@ register('MXTPU_TRACE_RING', int, 16384,
 register('MXTPU_FLIGHT_STEPS', int, 64,
          'Flight recorder depth: per-step span summaries (+ loss and '
          'guard flags) retained for the crash-time dump.')
-register('MXTPU_FLIGHT_PATH', str, 'mxtpu_flight.json',
-         'Where the flight recorder writes its post-mortem JSON '
-         '(watchdog stall, guard rollback, atexit/fatal-signal hook).')
+register('MXTPU_FLIGHT_DIR', str, '',
+         'Directory for flight-recorder post-mortem dumps '
+         '(mxtpu_flight-<pid>.json). Empty (default): the system temp '
+         'directory — the recorder never litters the CWD. Ignored when '
+         'MXTPU_FLIGHT_PATH names an explicit file.')
+register('MXTPU_FLIGHT_PATH', str, '',
+         'Explicit path of the flight-recorder post-mortem JSON '
+         '(watchdog stall, guard rollback, atexit/fatal-signal hook). '
+         'Empty (default): MXTPU_FLIGHT_DIR/mxtpu_flight-<pid>.json.')
 register('MXNET_TPU_RECOMPILE_WARN_THRESHOLD', int, 3,
          'Telemetry recompile detector: warn (once per compile site) '
          'when one site, e.g. a hybridized block, compiles more than '
@@ -328,6 +334,45 @@ register('MXTPU_HIERARCHICAL_DP', int, 0,
          'DCN hop. 0 (default): auto-detect host groups from the '
          'device->process topology; 1: force flat (single hop); N>=2: '
          'force N equal host groups (CPU simulation / drills).')
+register('MXTPU_METRICS_PORT', int, 0,
+         'Base TCP port of the per-process observability endpoint '
+         '(telemetry.server): rank r serves GET /metrics (Prometheus '
+         'exposition), /healthz (membership view + stall verdict + '
+         'last committed step) and /flight (on-demand flight-recorder '
+         'dump) on base + r. 0 (default): no server — the step path is '
+         'untouched. The server binds localhost-only unless '
+         'MXTPU_METRICS_BIND says otherwise, never touches the ICI '
+         'collectives, and answers with bounded handler threads.')
+register('MXTPU_METRICS_BIND', str, '127.0.0.1',
+         'Bind address of the observability endpoint. The default '
+         'stays loopback-only; set 0.0.0.0 deliberately when a fleet '
+         'scraper lives off-host.')
+register('MXTPU_FLEET_WINDOW', int, 32,
+         'Rolling window (snapshots per rank) the fleet anomaly '
+         'detectors baseline over: step-time regression and loss-spike '
+         'statistics are computed against this many recent snapshots.')
+register('MXTPU_FLEET_REGRESSION_FACTOR', float, 2.0,
+         "Fleet detector: a rank's step wall time above this multiple "
+         'of its own rolling baseline is flagged as a step-time '
+         'regression (flight note fleet.step_regression).')
+register('MXTPU_FLEET_STRAGGLER_FACTOR', float, 1.5,
+         "Fleet detector: a rank's step wall time above this multiple "
+         'of the fleet median is flagged as a straggler (flight note '
+         'fleet.straggler; the watchdog verdict names the rank).')
+register('MXTPU_FLEET_STALE_SECONDS', float, 0.0,
+         'Fleet detector: a rank whose newest telemetry snapshot is '
+         'older than this is flagged as stale/straggling even if its '
+         'last reported step time was healthy. 0 (default): 3x the '
+         'membership heartbeat period.')
+register('MXTPU_FLEET_LOSS_SPIKE_SIGMA', float, 6.0,
+         'Fleet detector: a reported loss above the rolling mean plus '
+         'this many rolling standard deviations (window '
+         'MXTPU_FLEET_WINDOW, minimum 8 samples) is flagged as a loss '
+         'spike (flight note fleet.loss_spike).')
+register('MXTPU_FLEET_IMBALANCE_FACTOR', float, 1.5,
+         'Fleet detector: max/min ratio of per-rank comm bytes per '
+         'step above this is flagged as a collective imbalance '
+         '(flight note fleet.comm_imbalance).')
 register('MXTPU_SCRUB_SECONDS', float, 300.0,
          'Background checkpoint scrubber cadence: every this many '
          'seconds the scrubber re-hashes one pass over the committed '

@@ -24,6 +24,7 @@ ages, every process runs a sender thread beating once per
 """
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
@@ -400,6 +401,28 @@ class Membership:
         self._stop = threading.Event()
         self._threads = []
         self._server = None
+        # fleet-telemetry piggyback (ISSUE 13): a provider callable
+        # yields a compact snapshot dict attached to each beat; the
+        # coordinator keeps the newest per rank and hands each one to
+        # on_snapshot (the fleet monitor) OUTSIDE the membership lock
+        # (and, for remote beats, AFTER the reply is written — the
+        # hook must not inflate the sender's measured RTT).
+        # on_peers_removed mirrors remove_peers into the monitor so a
+        # departed rank cannot haunt the straggler verdict forever.
+        self.telemetry_provider = None
+        self.on_snapshot = None
+        self.on_peers_removed = None
+        # coordinator-side: a callable returning the current flagged
+        # straggler summary (or None), attached to every reply — so
+        # WORKER watchdogs can name the suspect too, not just rank 0
+        # ((world-1)/world of wedges happen on a non-coordinator)
+        self.verdict_provider = None
+        self._telem = {}              # rank -> {'snap','mono','time'}
+        # (rtt, offset, when) samples of this clock vs the
+        # coordinator's, one per beat round-trip; the min-RTT sample in
+        # the window is the clock_offset() estimate (NTP's intuition:
+        # the tightest round-trip bounds the asymmetry error best)
+        self._off_samples = collections.deque(maxlen=64)
         # coordinator state (rank 0)
         now = _time.monotonic()
         self._last_beat = {r: now for r in range(self.world)}
@@ -472,19 +495,77 @@ class Membership:
                 continue
             except OSError:
                 return
+            msg = None
             try:
                 conn.settimeout(1.0)
                 with conn, conn.makefile('rwb') as f:
                     line = f.readline()
                     if not line:
                         continue
-                    reply = self._handle(json.loads(line.decode()))
+                    msg = json.loads(line.decode())
+                    reply = self._finish_reply(self._handle_locked(msg))
                     f.write(json.dumps(reply).encode() + b'\n')
                     f.flush()
             except (OSError, ValueError):
-                continue
+                pass
+            # hooks AFTER the reply is on the wire (the fleet monitor's
+            # detector pass must not inflate the sender's measured beat
+            # RTT) — but regardless of whether the write SUCCEEDED:
+            # _handle_locked already mutated state, and skipping e.g.
+            # the 'remove' mirror on a client disconnect would leave a
+            # departed rank haunting the monitor forever
+            if msg is not None:
+                self._run_hooks(msg)
 
     def _handle(self, msg):
+        reply = self._finish_reply(self._handle_locked(msg))
+        self._run_hooks(msg)
+        return reply
+
+    def _finish_reply(self, reply):
+        """Reply enrichment, outside the membership lock: the
+        coordinator wall clock ('now' — stamped as close to the reply
+        as possible, the sender's round-trip turns it into a
+        clock-offset sample) and the current flagged straggler summary
+        (so every rank's cached view can upgrade its own watchdog
+        verdict)."""
+        if not isinstance(reply, dict):
+            return reply
+        reply['now'] = _time.time()
+        provider = self.verdict_provider
+        if provider is not None:
+            try:
+                s = provider()
+                if s is not None:
+                    reply['straggler'] = s
+            except Exception:
+                pass
+        return reply
+
+    def _run_hooks(self, msg):
+        """Fleet hooks, OUTSIDE the membership lock: the monitor takes
+        its own lock and emits flight notes/metrics — nesting those
+        acquisitions under self._lock would add a cross-module lock
+        edge (tools/mxtpu_lint lock-order rule). Remote requests run
+        this after the reply is written (see _serve)."""
+        op = msg.get('op')
+        if op == 'beat' and msg.get('telem') is not None:
+            hook = self.on_snapshot
+            if hook is not None:
+                try:
+                    hook(int(msg.get('rank', -1)), msg['telem'])
+                except Exception:
+                    _log.exception("membership: on_snapshot hook failed")
+        elif op == 'remove':
+            hook = self.on_peers_removed
+            if hook is not None:
+                try:
+                    hook([int(r) for r in msg.get('ranks', [])])
+                except Exception:
+                    _log.exception(
+                        "membership: on_peers_removed hook failed")
+
+    def _handle_locked(self, msg):
         op = msg.get('op')
         r = int(msg.get('rank', -1))
         with self._lock:
@@ -492,6 +573,10 @@ class Membership:
                 self._last_beat[r] = _time.monotonic()
                 if msg.get('step') is not None:
                     self._steps[r] = int(msg['step'])
+                if msg.get('telem') is not None:
+                    self._telem[r] = {'snap': msg['telem'],
+                                      'mono': _time.monotonic(),
+                                      'time': _time.time()}
             elif op == 'leave':
                 self._left.add(r)
             elif op in ('barrier', 'barrier_poll'):
@@ -529,6 +614,7 @@ class Membership:
             elif op == 'remove':
                 for x in msg.get('ranks', []):
                     self._left.add(int(x))
+                    self._telem.pop(int(x), None)
             return self._view_locked()
 
     def _view_locked(self):
@@ -561,20 +647,77 @@ class Membership:
     def beat(self, step=None):
         """One heartbeat round-trip (the sender thread's body; callable
         directly from tests and training loops). Updates the cached
-        membership view."""
+        membership view, attaches the fleet telemetry snapshot (when a
+        provider is set) and feeds the clock-offset estimator."""
         if step is not None:
             self.current_step = int(step)
         if _telem['on']:
             from .. import telemetry as _telemetry
             _telemetry.inc('mxnet_tpu_elastic_heartbeats_total')
         msg = {'op': 'beat', 'rank': self.rank, 'step': self.current_step}
+        provider = self.telemetry_provider
+        if provider is not None:
+            try:
+                snap = provider()
+            except Exception:
+                _log.exception("membership: telemetry provider failed")
+                snap = None
+            if snap is not None:
+                msg['telem'] = snap
         if self.is_coordinator:
             view = self._handle(msg)
             with self._lock:
                 self._view = view
                 self._last_ok = _time.monotonic()
             return view
-        return self._request(msg)
+        t0, m0 = _time.time(), _time.monotonic()
+        view = self._request(msg)
+        t1, m1 = _time.time(), _time.monotonic()
+        self._note_offset(t0, t1, view.get('now'), rtt=m1 - m0)
+        return view
+
+    def _note_offset(self, t0, t1, coord_now, rtt=None):
+        """One clock-offset sample from a beat round-trip: the
+        coordinator stamped ``coord_now`` between our send (t0) and
+        receive (t1), so offset = coord_now - midpoint with error
+        bounded by rtt/2. The rtt MUST come from a monotonic pair: an
+        NTP step between send and receive would otherwise fabricate a
+        near-zero wall-clock rtt whose poisoned offset wins the
+        min-RTT window for the next 64 beats."""
+        if coord_now is None:
+            return
+        rtt = max(0.0, rtt if rtt is not None else t1 - t0)
+        with self._lock:
+            self._off_samples.append(
+                (rtt, float(coord_now) - (t0 + t1) / 2.0, t1))
+
+    def clock_offset(self):
+        """(offset_seconds, rtt_seconds) such that ``local wall clock +
+        offset ~= coordinator wall clock``, from the minimum-RTT beat in
+        the recent sample window (error <= rtt/2) — what
+        ``tools/stitch_traces.py`` shifts per-rank trace timestamps by.
+        The coordinator is the reference clock: (0.0, 0.0). None before
+        the first completed round-trip."""
+        if self.is_coordinator:
+            return (0.0, 0.0)
+        with self._lock:
+            if not self._off_samples:
+                return None
+            rtt, off, _when = min(self._off_samples)
+        return (off, rtt)
+
+    def fleet_snapshots(self):
+        """{rank: {'snap', 'age_seconds', 'time'}} — the newest
+        telemetry snapshot each rank piggybacked on a heartbeat.
+        Coordinator-side state: snapshots are stored where beats are
+        handled, so workers always see {} (read the merged fleet view
+        from the coordinator's /healthz instead)."""
+        now = _time.monotonic()
+        with self._lock:
+            return {int(r): {'snap': e['snap'],
+                             'age_seconds': round(now - e['mono'], 3),
+                             'time': e['time']}
+                    for r, e in self._telem.items()}
 
     def _request(self, msg, timeout=None):
         timeout = timeout if timeout is not None else \
@@ -686,6 +829,8 @@ class Membership:
         # a removed peer into the next survivor computation
         rs = set(int(r) for r in ranks)
         with self._lock:
+            for r in rs:
+                self._telem.pop(r, None)
             if self._view:
                 self._view['lost'] = [r for r in self._view.get('lost', [])
                                       if int(r) not in rs]
@@ -729,6 +874,17 @@ class Membership:
             self._left = set()
             self._last_ok = now
         self.start()
+        # fleet observability followed the OLD coordinator: if this
+        # rank was reporting snapshots, the promotion must also make it
+        # the merge point — otherwise worker snapshots arriving here
+        # are dropped and the degraded fleet goes dark exactly when it
+        # most needs watching
+        if self.telemetry_provider is not None:
+            try:
+                from ..telemetry import fleet as _fleet
+                _fleet.attach(self)
+            except Exception:
+                _log.exception("fleet re-attach after promotion failed")
         return self
 
     def barrier(self, tag, timeout=None):
@@ -803,6 +959,17 @@ def start_membership(coordinator=None, num_processes=None, process_id=None,
     kwargs.setdefault('port', _elastic_port(coordinator))
     _membership = Membership(process_id, num_processes,
                              coordinator_host=host, **kwargs)
+    # fleet observability (ISSUE 13): heartbeats piggyback telemetry
+    # snapshots, the coordinator merges them, and the per-process
+    # /metrics//healthz//flight endpoint arms iff MXTPU_METRICS_PORT
+    # is set. Never fatal — observability must not take down training.
+    try:
+        from ..telemetry import fleet as _fleet, server as _tserver
+        _fleet.attach(_membership)
+        _tserver.maybe_start(rank=_membership.rank,
+                             membership=_membership)
+    except Exception:
+        _log.exception("fleet observability bring-up failed")
     return _membership
 
 

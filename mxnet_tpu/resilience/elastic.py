@@ -105,14 +105,18 @@ def raise_if_peer_lost():
 def stall_verdict(membership=None):
     """Classify a stall: ``peer_loss`` (some peer's heartbeat age is
     past the deadline — the wedge is a REMOTE preemption) vs
-    ``local_stall`` (every peer is beating — the wedge is local code).
+    ``straggler`` (every peer heartbeats but the fleet telemetry names
+    a slowest/most-stale rank — ISSUE 13) vs ``local_stall`` (every
+    peer is beating and nobody straggles — the wedge is local code).
     Returns ``{'verdict', 'peer_ages', 'lost', 'deadline_seconds'}``
     (plus ``'during': 'replica_fetch'`` when a checkpoint replica fetch
     is in flight — then the serving peer is the prime suspect even
-    while it still heartbeats, so the verdict is peer loss suspected,
-    not a bare local stall) or None when no membership layer is running
-    and nothing remote is in flight (single-process jobs have no peers
-    to blame)."""
+    while it still heartbeats — and ``'straggler'`` when cross-rank
+    fleet snapshots are available: the suspected rank with its
+    last-snapshot age, ``flagged`` saying whether a detector actually
+    tripped vs a worst-of-fleet fallback) or None when no membership
+    layer is running and nothing remote is in flight (single-process
+    jobs have no peers to blame)."""
     fetching = 0
     try:
         from ..checkpoint import replica as _replica
@@ -143,6 +147,27 @@ def stall_verdict(membership=None):
     }
     if fetching:
         v['during'] = 'replica_fetch'
+    # fleet straggler upgrade (ISSUE 13): when cross-rank telemetry
+    # snapshots are flowing, a "local" stall with a detector-flagged
+    # straggler is most likely THIS rank waiting inside a collective on
+    # the named rank — the verdict says so instead of blaming local
+    # code. The coordinator reads its own monitor; every other rank
+    # reads the flagged summary the coordinator attaches to each beat
+    # reply (cached in the membership view, refreshed by the daemon
+    # heartbeat thread even while the training thread is wedged).
+    try:
+        from ..telemetry import fleet as _fleet
+        mon = _fleet.monitor()
+        if mon is not None:
+            s = mon.straggler(worst=True)
+        else:
+            s = (membership.view() or {}).get('straggler')
+        if s is not None:
+            v['straggler'] = s
+            if v['verdict'] == 'local_stall' and s.get('flagged'):
+                v['verdict'] = 'straggler_suspected'
+    except Exception:
+        pass
     return v
 
 

@@ -164,6 +164,8 @@ class StepWatchdog:
                             lost_peers=verdict['lost'])
                 if verdict.get('during'):
                     note['during'] = verdict['during']
+                if verdict.get('straggler'):
+                    note['straggler'] = verdict['straggler']
             _flight.note('watchdog.stall', **note)
             path = _flight.dump(reason='watchdog_stall')
             if path:
@@ -238,12 +240,34 @@ class StepWatchdog:
                     f"(last-heartbeat ages per peer: "
                     f"{verdict['peer_ages']}). The fetch itself is "
                     f"bounded by MXTPU_REPLICA_TIMEOUT_SECONDS."))
+            elif verdict.get('verdict') == 'straggler_suspected':
+                s = verdict['straggler']
+                lines.insert(1, (
+                    f"verdict: STRAGGLER SUSPECTED: rank {s['rank']} — "
+                    f"every peer still heartbeats, but the fleet "
+                    f"telemetry names rank {s['rank']} as the "
+                    f"{'most-stale' if s['reason'] == 'stale' else 'slowest'}"
+                    f" rank (last snapshot "
+                    f"{s.get('snapshot_age_seconds')}s ago, step "
+                    f"{s.get('step')} vs fleet max {s.get('max_step')}); "
+                    f"this process is most likely wedged inside a "
+                    f"collective waiting on it."))
             else:
+                s = verdict.get('straggler')
+                suffix = ''
+                if s is not None:
+                    suffix = (
+                        f" Fleet telemetry's worst rank: {s['rank']} "
+                        f"({s['reason']}, last snapshot "
+                        f"{s.get('snapshot_age_seconds')}s ago, step "
+                        f"{s.get('step')} vs fleet max "
+                        f"{s.get('max_step')}) — below the detector "
+                        f"thresholds.")
                 lines.insert(1, (
                     f"verdict: LOCAL STALL — every peer is still "
                     f"heartbeating (last-heartbeat ages per peer: "
                     f"{verdict['peer_ages']}); the wedge is in THIS "
-                    f"process."))
+                    f"process.{suffix}"))
         lines.append(format_all_stacks())
         try:
             from .. import telemetry as _telemetry

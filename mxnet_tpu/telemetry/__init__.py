@@ -17,6 +17,13 @@ cooperating layers:
 - ``telemetry.attribution`` — joins measured spans with XLA
   cost_analysis into the per-step input/h2d/compute/collective/
   host-sync breakdown bench.py and tools/tune_bert_step.py report.
+- ``telemetry.fleet`` — cross-rank aggregation: per-step snapshots
+  piggybacked on membership heartbeats, merged into a coordinator
+  fleet view with per-rank skew, clock-offset estimation for trace
+  stitching, and streaming straggler/regression/loss-spike/imbalance
+  detectors.
+- ``telemetry.server`` — the per-process /metrics + /healthz +
+  /flight HTTP endpoint (``MXTPU_METRICS_PORT``, off by default).
 """
 from .metrics import *  # noqa: F401,F403  (the PR-1 registry API, unchanged)
 from .metrics import (  # noqa: F401  (non-__all__ names used by tests/tools)
@@ -26,5 +33,8 @@ from .metrics import __all__ as _metrics_all
 from . import trace          # noqa: F401
 from . import flight         # noqa: F401
 from . import attribution    # noqa: F401
+from . import fleet          # noqa: F401
+from . import server         # noqa: F401
 
-__all__ = list(_metrics_all) + ['trace', 'flight', 'attribution']
+__all__ = list(_metrics_all) + ['trace', 'flight', 'attribution',
+                                'fleet', 'server']

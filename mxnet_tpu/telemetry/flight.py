@@ -43,7 +43,8 @@ from ..base import telem_flags as _telem
 from . import trace as _trace
 
 __all__ = ['FlightRecorder', 'get', 'record_step', 'note',
-           'annotate_last', 'dump', 'install_crash_hooks']
+           'annotate_last', 'dump', 'default_dump_path',
+           'install_crash_hooks']
 
 
 class FlightRecorder:
@@ -157,6 +158,12 @@ class FlightRecorder:
         with self._locked_for_dump():
             return [dict(r) for r in self._steps]
 
+    def last_step_record(self):
+        """The newest step record (copy), or None — the fleet snapshot
+        builder's per-step source; never drains the ring."""
+        with self._locked_for_dump():
+            return dict(self._steps[-1]) if self._steps else None
+
     def events(self):
         with self._locked_for_dump():
             return [dict(e) for e in self._events]
@@ -207,8 +214,7 @@ class FlightRecorder:
         if empty and not _trace.stats()['spans_total']:
             return None
         if path is None:
-            from .. import config as _config
-            path = _config.get('MXTPU_FLIGHT_PATH')
+            path = default_dump_path()
         doc = self.snapshot(resolve_loss=False, signal_safe=signal_safe)
         doc['reason'] = reason or 'manual'
         self.dumps += 1
@@ -247,6 +253,23 @@ class FlightRecorder:
             self._events.clear()
             self._last_t = None
             self._pending_loss = None
+
+
+def default_dump_path():
+    """Where a dump with no explicit path lands: MXTPU_FLIGHT_PATH when
+    set, else MXTPU_FLIGHT_DIR (default: the system temp directory —
+    never the CWD) + mxtpu_flight-<pid>.json. The pid suffix keeps the
+    ranks of a multi-process job from clobbering each other's black
+    box."""
+    from .. import config as _config
+    explicit = _config.get('MXTPU_FLIGHT_PATH')
+    if explicit:
+        return explicit
+    d = _config.get('MXTPU_FLIGHT_DIR')
+    if not d:
+        import tempfile
+        d = tempfile.gettempdir()
+    return os.path.join(d, f'mxtpu_flight-{os.getpid()}.json')
 
 
 _recorder = None

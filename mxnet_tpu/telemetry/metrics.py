@@ -39,7 +39,8 @@ from ..base import MXNetError, telem_flags as _telem
 __all__ = [
     'enable', 'disable', 'enabled', 'reset', 'report', 'dump', 'prometheus',
     'chrome_events', 'counter', 'gauge', 'histogram', 'inc', 'set_gauge',
-    'observe', 'value', 'record_compile', 'record_cache_hit', 'record_step',
+    'observe', 'value', 'series', 'remove_series', 'record_compile',
+    'record_cache_hit', 'record_step',
     'recent_samples_per_second', 'set_step_flops',
     'set_recompile_threshold', 'RecompileWarning',
     'Counter', 'Gauge', 'Histogram',
@@ -82,6 +83,20 @@ class Metric:
     def labelsets(self):
         with self._lock:
             return list(self._values)
+
+    def remove_matching(self, **labels):
+        """Drop every recorded labelset whose labels are a superset of
+        ``labels`` (e.g. ``remove_matching(rank=3)`` retires all of a
+        departed rank's series regardless of other labels). Returns the
+        number of series removed."""
+        want = _label_key(labels)
+        removed = 0
+        with self._lock:
+            for key in list(self._values):
+                if set(want) <= set(key):
+                    del self._values[key]
+                    removed += 1
+        return removed
 
     def _fmt_labels(self, key: Tuple) -> str:
         if not key:
@@ -204,6 +219,29 @@ def value(name: str, **labels):
     with _lock:
         m = _metrics.get(name)
     return None if m is None else m.value(**labels)
+
+
+def remove_series(name: str, **labels):
+    """Retire every labelset of ``name`` matching the ``labels`` subset
+    (no-op for an unregistered metric). The fleet monitor uses this to
+    evict a departed rank's gauge rows — a ghost rank frozen at its
+    last values would otherwise haunt every scrape."""
+    with _lock:
+        m = _metrics.get(name)
+    return 0 if m is None else m.remove_matching(**labels)
+
+
+def series(name: str):
+    """[(labels dict, raw value)] for every recorded labelset of a
+    metric — the read the fleet snapshot builder aggregates over.
+    Empty when the metric was never recorded."""
+    with _lock:
+        m = _metrics.get(name)
+    if m is None:
+        return []
+    with m._lock:
+        items = sorted(m._values.items())
+    return [(dict(key), v) for key, v in items]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,321 @@
+"""Per-process observability endpoint: /metrics, /healthz, /flight.
+
+A fleet is only operable if every replica answers "how are you" over
+plain HTTP — the ROADMAP's serving item needs per-replica health and
+metrics endpoints, and a Prometheus scraper should not have to link
+against the framework. This is a tiny stdlib TCP server in the same
+idiom as the membership/replica side channels (``parallel.dist``): it
+never touches the ICI collectives (a wedged collective must not make
+the *diagnosis* port unreachable too), binds loopback-only by default,
+and answers with a BOUNDED pool of handler threads — a scrape storm
+degrades to refused connections, never to unbounded thread growth.
+
+Endpoints (GET only):
+
+- ``/metrics``  — the metrics registry in Prometheus text exposition
+  format (exactly ``telemetry.prometheus()``; empty until
+  ``MXNET_TPU_TELEMETRY=1`` arms the registry).
+- ``/healthz``  — JSON health document: membership view, the
+  classified stall verdict (``resilience.elastic.stall_verdict``),
+  last completed + last committed step, and — on the membership
+  coordinator — the merged fleet view with per-rank skew.
+- ``/flight``   — the flight recorder's post-mortem document on
+  demand (the same JSON a crash dump writes; loss reads skipped so a
+  wedged device can never wedge the endpoint).
+
+Armed by ``MXTPU_METRICS_PORT`` (0 = off; rank r serves on base + r so
+multi-process hosts do not collide) — ``parallel.dist`` starts it
+alongside the membership layer, or call ``start()`` directly.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time as _time
+
+__all__ = ['TelemetryServer', 'start', 'stop', 'get', 'maybe_start']
+
+_log = logging.getLogger('mxnet_tpu.telemetry')
+
+_MAX_REQUEST_BYTES = 8192
+
+
+class TelemetryServer:
+    """One process's observability endpoint. ``port=0`` picks a free
+    port (tests); ``max_handlers`` bounds concurrent handler threads —
+    excess connections are closed immediately (a scraper retries; the
+    process never grows a thread per stuck client)."""
+
+    def __init__(self, port=0, bind=None, membership=None,
+                 max_handlers=4, start=True):
+        from .. import config as _config
+        self.bind = bind if bind is not None \
+            else _config.get('MXTPU_METRICS_BIND')
+        self.membership = membership
+        self.max_handlers = int(max_handlers)
+        self._slots = threading.Semaphore(self.max_handlers)
+        self._stop = threading.Event()
+        self._server = None
+        self._thread = None
+        self.port = int(port)
+        self.requests = 0
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._server is not None:
+            return self
+        self._stop.clear()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.bind, self.port))
+        self.port = srv.getsockname()[1]
+        srv.listen(16)
+        srv.settimeout(0.2)
+        self._server = srv
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name='mxtpu-telemetry-http')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- accept loop -------------------------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not self._slots.acquire(blocking=False):
+                # at capacity: shed load instead of queueing threads —
+                # the scraper sees a reset and retries next interval
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                t = threading.Thread(target=self._handle_conn,
+                                     args=(conn,), daemon=True,
+                                     name='mxtpu-telemetry-req')
+                t.start()
+            except Exception:
+                # thread exhaustion: give the slot BACK (the release
+                # lives in _handle_conn, which never ran — leaking here
+                # would brick the endpoint after max_handlers failures)
+                # and keep accepting; the client retries next interval
+                self._slots.release()
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _handle_conn(self, conn):
+        try:
+            conn.settimeout(5.0)
+            with conn:
+                path = self._read_request(conn)
+                if path is None:
+                    return
+                self.requests += 1
+                status, ctype, body = self._route(path)
+                head = (f'HTTP/1.0 {status}\r\n'
+                        f'Content-Type: {ctype}\r\n'
+                        f'Content-Length: {len(body)}\r\n'
+                        f'Connection: close\r\n\r\n')
+                conn.sendall(head.encode() + body)
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._slots.release()
+
+    @staticmethod
+    def _read_request(conn, deadline_seconds=5.0):
+        """Path of a GET request, or None for anything malformed. Reads
+        at most _MAX_REQUEST_BYTES within ONE overall wall deadline —
+        headers are ignored, bodies rejected by the byte bound, and a
+        trickling client (one byte per recv, each resetting the socket
+        timeout) cannot hold a handler slot past the deadline."""
+        deadline = _time.monotonic() + deadline_seconds
+        data = b''
+        while b'\r\n' not in data and len(data) < _MAX_REQUEST_BYTES:
+            if _time.monotonic() > deadline:
+                return None
+            b = conn.recv(1024)
+            if not b:
+                break
+            data += b
+        line = data.split(b'\r\n', 1)[0].decode('latin-1', 'replace')
+        parts = line.split()
+        if len(parts) < 2 or parts[0] != 'GET':
+            return None
+        return parts[1].split('?', 1)[0]
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, path):
+        try:
+            if path == '/metrics':
+                from . import fleet as _fleet
+                from . import metrics as _metrics
+                mon = _fleet.monitor()
+                if mon is not None:
+                    # snapshot-age gauges refresh at scrape time: a
+                    # SILENT rank's age must keep growing even though
+                    # its own ingests (the only per-rank writers)
+                    # stopped — that growing age is the alert signal
+                    mon.refresh_gauges()
+                return ('200 OK',
+                        'text/plain; version=0.0.4; charset=utf-8',
+                        _metrics.prometheus().encode())
+            if path == '/healthz':
+                doc = self.health()
+                status = '200 OK' if doc.get('status') == 'ok' \
+                    else '503 Service Unavailable'
+                return (status, 'application/json',
+                        json.dumps(doc, default=str).encode())
+            if path == '/flight':
+                from . import flight as _flight
+                doc = _flight.get().snapshot(resolve_loss=False)
+                return ('200 OK', 'application/json',
+                        json.dumps(doc, default=str).encode())
+            return ('404 Not Found', 'text/plain',
+                    b'endpoints: /metrics /healthz /flight\n')
+        except Exception as e:
+            _log.exception("telemetry endpoint %s failed", path)
+            return ('500 Internal Server Error', 'text/plain',
+                    repr(e).encode())
+
+    def health(self):
+        """The /healthz document (also callable in-process). Reads only
+        local state — membership views, the flight recorder, checkpoint
+        bookkeeping — never a collective or a device sync."""
+        from . import fleet as _fleet, flight as _flight
+        from . import metrics as _metrics
+        from ..base import telem_flags as _telem
+        from . import trace as _trace
+        doc = {'status': 'ok', 'pid': os.getpid(),
+               'time': round(_time.time(), 3),
+               'telemetry': bool(_telem['on']),
+               'trace': bool(_trace.enabled())}
+        ms = self.membership
+        if ms is None:
+            from ..parallel import dist as _dist
+            ms = _dist.membership()
+        if ms is not None:
+            doc['rank'] = ms.rank
+            doc['membership'] = ms.view()
+            off = ms.clock_offset()
+            if off is not None:
+                doc['clock_offset_seconds'] = round(off[0], 6)
+        rec = _flight.get().last_step_record()
+        if rec is not None:
+            doc['last_step'] = rec.get('step')
+            doc['last_step_wall_ms'] = rec.get('interval_ms')
+        sps = _metrics.recent_samples_per_second(60.0)
+        if sps is not None:
+            doc['samples_per_second'] = sps
+        try:
+            from ..checkpoint import last_committed_step
+            doc['last_committed_step'] = last_committed_step()
+        except Exception:
+            doc['last_committed_step'] = None
+        try:
+            from ..resilience.elastic import stall_verdict
+            doc['verdict'] = stall_verdict(ms)
+        except Exception:
+            doc['verdict'] = None
+        mon = _fleet.monitor()
+        if mon is not None:
+            doc['fleet'] = mon.view()
+        v = doc.get('verdict') or {}
+        s = v.get('straggler') or {}
+        if v.get('lost'):
+            doc['status'] = 'peer_loss'
+        elif s.get('flagged') and s.get('rank') == doc.get('rank'):
+            # a detector tripped naming THIS rank: degrade our own
+            # health so an external supervisor sees the same suspect
+            doc['status'] = 'straggler'
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# process-global instance
+# ---------------------------------------------------------------------------
+
+_server = None
+_server_lock = threading.RLock()
+
+
+def get():
+    """The process-global TelemetryServer, or None (disarmed)."""
+    return _server
+
+
+def start(port=None, rank=0, membership=None, **kwargs):
+    """Start (or return) the process-global endpoint. ``port=None``
+    reads ``MXTPU_METRICS_PORT`` + rank; an explicit port is used
+    as-is."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            return _server
+        if port is None:
+            from .. import config as _config
+            base = int(_config.get('MXTPU_METRICS_PORT') or 0)
+            if not base:
+                return None
+            port = base + int(rank)
+        _server = TelemetryServer(port=int(port), membership=membership,
+                                  **kwargs)
+    return _server
+
+
+def stop():
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def maybe_start(rank=None, membership=None):
+    """Arm the endpoint iff MXTPU_METRICS_PORT is set (the
+    ``parallel.dist`` bring-up hook). Never raises — observability must
+    not take down training."""
+    try:
+        if rank is None:
+            from .. import config as _config
+            rank = membership.rank if membership is not None \
+                else max(0, _config.get('MXNET_TPU_PROC_ID'))
+        return start(rank=rank, membership=membership)
+    except Exception:
+        _log.exception("telemetry endpoint failed to start")
+        return None

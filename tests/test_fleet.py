@@ -1,0 +1,917 @@
+"""Fleet observability plane (ISSUE 13): /metrics + /healthz + /flight
+endpoints, heartbeat-piggybacked telemetry snapshots, the coordinator's
+fleet view + anomaly detectors, clock-offset estimation and distributed
+trace stitching.
+
+The two-process drill at the bottom is the acceptance path: two real
+ranks with endpoints armed, an injected slow rank flagged by the
+straggler detector and named in the watchdog verdict, fleet gauges
+agreeing exactly with the per-rank comm counters, and the stitched
+trace passing tools/check_trace.py.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+import urllib.error
+import urllib.request
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, telemetry
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.resilience import StepWatchdog
+from mxnet_tpu.resilience.elastic import stall_verdict
+from mxnet_tpu.telemetry import fleet, flight, server, trace
+
+TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, 'tools')
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    fleet._monitor = None
+    server.stop()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    fleet._monitor = None
+    server.stop()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+def _wait_until(cond, timeout=5.0):
+    """Snapshot hooks run AFTER the beat reply is written (so the
+    detector pass can't inflate the sender's measured RTT) — a worker's
+    beat() returning does not mean the coordinator has ingested yet."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+def test_estimate_offset_prefers_min_rtt():
+    # sample 1: rtt 100ms, midpoint 0.05, remote said 5.05 -> off 5.0
+    # sample 2: rtt 20ms, midpoint 1.01, remote said 6.013 -> off 5.003
+    off, rtt = fleet.estimate_offset(
+        [(0.0, 0.10, 5.05), (1.0, 1.02, 6.013)])
+    assert abs(off - 5.003) < 1e-9
+    assert abs(rtt - 0.02) < 1e-9
+    assert fleet.estimate_offset([]) is None
+
+
+def test_estimate_offset_monotonic_rtt_beats_wallclock_step():
+    # an NTP step backward between send and receive fabricates a
+    # near-zero WALL-clock rtt; the explicit monotonic rtt (4th
+    # element) must be what the min-RTT selection ranks by
+    honest = (10.0, 10.002, 15.001, 0.002)        # off 5.0, rtt 2 ms
+    poisoned = (20.0, 19.951, 24.9755, 0.049)     # clock stepped -50ms
+    off, rtt = fleet.estimate_offset([poisoned, honest])
+    assert abs(off - 5.0) < 1e-9 and rtt == 0.002
+    # 3-tuple fallback still works for offline wall-clock recordings
+    assert fleet.estimate_offset([(0.0, 0.1, 5.05)]) is not None
+
+
+def test_attach_plumbs_real_heartbeat_into_stale_threshold():
+    port = _free_port()
+    ms0 = dist.Membership(0, 2, port=port, heartbeat_seconds=10.0,
+                          deadline_seconds=60.0, start=False)
+    try:
+        mon = fleet.attach(ms0)
+        # env knob default is 1.0s -> auto threshold would be 3.0s and
+        # flag every healthy rank stale between 10s beats
+        assert mon.stale_seconds == 30.0, mon.stale_seconds
+        explicit = fleet.FleetMonitor(stale_seconds=7.0)
+        explicit.set_heartbeat(10.0)
+        assert explicit.stale_seconds == 7.0      # explicit wins
+    finally:
+        fleet.detach(ms0)
+        ms0.stop()
+
+
+def test_membership_clock_offset_roundtrip():
+    port = _free_port()
+    ms0 = dist.Membership(0, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    ms0.start()
+    ms1 = dist.Membership(1, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    try:
+        assert ms1.clock_offset() is None        # no round-trip yet
+        for _ in range(3):
+            ms1.beat()
+        off, rtt = ms1.clock_offset()
+        # same host, same clock: the offset must be tiny and the rtt
+        # bounded by a loopback round-trip
+        assert abs(off) < 0.5 and 0.0 <= rtt < 0.5
+        assert ms0.clock_offset() == (0.0, 0.0)  # reference clock
+    finally:
+        ms0.stop()
+        ms1.stop()
+
+
+# ---------------------------------------------------------------------------
+# local snapshots
+# ---------------------------------------------------------------------------
+
+def test_local_snapshot_disarmed_is_none():
+    assert fleet.local_snapshot() is None
+    assert fleet.snapshot_bytes() == 0
+
+
+def test_local_snapshot_carries_step_spans_comm_counters():
+    telemetry.enable()
+    trace.enable()
+    with trace.span('step.dispatch'):
+        with trace.span('io.batch'):
+            pass
+    flight.get().record_step(1)
+    time.sleep(0.005)
+    with trace.span('h2d.device_put'):
+        pass
+    flight.get().record_step(2)
+    telemetry.counter('mxnet_tpu_comm_collective_bytes_total').inc(
+        1000, kind='all_reduce', axis='dp', stage='zero1')
+    telemetry.counter('mxnet_tpu_comm_collective_bytes_total').inc(
+        24, kind='all_gather', axis='dph', stage='zero1')
+    telemetry.inc('mxnet_tpu_resilience_faults_injected_total',
+                  site='io.decode', fault_kind='raise')
+    snap = fleet.local_snapshot()
+    assert snap['step'] == 2
+    assert snap['wall_ms'] > 0
+    assert 'h2d' in snap['spans_ms']
+    assert snap['comm_bytes'] == {'dp': 1000, 'dph': 24}
+    assert snap['counters'] == {'faults': 1}
+    n = fleet.snapshot_bytes(snap)
+    assert 0 < n < 1024, f"snapshot unexpectedly large: {n} bytes"
+
+
+def test_snapshot_bytes_includes_the_offset_field():
+    telemetry.enable()
+    trace.enable()
+    with trace.span('step.dispatch'):
+        pass
+    flight.get().record_step(1)
+
+    class _MS:
+        def clock_offset(self):
+            return (0.000123, 0.0009)
+    bare = fleet.snapshot_bytes(fleet.local_snapshot())
+    wired = fleet.snapshot_bytes(membership=_MS())
+    # the measured number must be what the heartbeat actually carries —
+    # the provider-appended offset field included
+    assert wired > bare, (wired, bare)
+
+
+def test_comm_bytes_by_axis_aggregates_kinds():
+    telemetry.enable()
+    c = telemetry.counter('mxnet_tpu_comm_collective_bytes_total')
+    c.inc(10, kind='all_gather', axis='dp', stage='zero1')
+    c.inc(5, kind='reduce_scatter', axis='dp', stage='zero1')
+    c.inc(7, kind='all_reduce', axis='dph', stage='off')
+    assert fleet.comm_bytes_by_axis() == {'dp': 15, 'dph': 7}
+
+
+# ---------------------------------------------------------------------------
+# fleet view merge + detectors
+# ---------------------------------------------------------------------------
+
+def _mon(**kw):
+    kw.setdefault('heartbeat_seconds', 0.1)
+    kw.setdefault('stale_seconds', 30.0)
+    return fleet.FleetMonitor(**kw)
+
+
+def test_fleet_view_contains_ranks_and_skew():
+    mon = _mon()
+    for step in range(1, 4):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0, 'loss': 1.0})
+        mon.ingest(1, {'step': step, 'wall_ms': 300.0, 'loss': 1.1})
+    v = mon.view()
+    assert sorted(v['ranks']) == [0, 1]
+    assert v['fleet']['ranks'] == 2
+    assert v['fleet']['max_step'] == 3
+    # skew is against the fleet median (200): symmetric here
+    assert v['ranks'][0]['skew_ms'] == -100.0
+    assert v['ranks'][1]['skew_ms'] == 100.0
+    assert v['ranks'][1]['wall_ms'] == 300.0
+
+
+def test_straggler_detector_flags_slow_rank():
+    mon = _mon(straggler_factor=1.5)
+    fired = []
+    for step in range(1, 6):
+        fired += mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+        fired += mon.ingest(2, {'step': step, 'wall_ms': 105.0})
+        fired += mon.ingest(1, {'step': step, 'wall_ms': 400.0})
+    kinds = [(k, i['rank']) for k, i in fired]
+    assert ('fleet.straggler', 1) in kinds
+    s = mon.straggler()
+    assert s['rank'] == 1 and s['reason'] == 'slow' and s['flagged']
+    assert s['wall_ms'] == 400.0
+
+
+def test_straggler_detector_flags_stale_rank():
+    mon = _mon(stale_seconds=0.05)
+    mon.ingest(1, {'step': 1, 'wall_ms': 100.0})
+    time.sleep(0.12)
+    fired = mon.ingest(0, {'step': 1, 'wall_ms': 100.0})
+    stale = [i for k, i in fired if k == 'fleet.straggler'
+             and i['reason'] == 'stale']
+    assert stale and stale[0]['rank'] == 1
+    assert stale[0]['snapshot_age_seconds'] >= 0.05
+    s = mon.straggler()
+    assert s['rank'] == 1 and s['reason'] == 'stale'
+    # a fresh snapshot clears the flag
+    mon.ingest(1, {'step': 2, 'wall_ms': 100.0})
+    assert mon.straggler() is None
+
+
+def test_step_time_regression_detector():
+    mon = _mon(regression_factor=2.0)
+    fired = []
+    for step in range(1, 6):
+        fired += mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+    assert not fired
+    fired = mon.ingest(0, {'step': 6, 'wall_ms': 500.0})
+    kinds = [k for k, _i in fired]
+    assert 'fleet.step_regression' in kinds
+    info = dict(fired)['fleet.step_regression']
+    assert info['rank'] == 0 and info['factor'] >= 2.0
+    # latched: no duplicate note while the excursion continues
+    again = mon.ingest(0, {'step': 7, 'wall_ms': 500.0})
+    assert 'fleet.step_regression' not in [k for k, _ in again]
+
+
+def test_regression_detector_uses_pre_update_baseline():
+    # the excursion must be judged against the baseline as it stood
+    # BEFORE the sample — folding it in first made factor >= 5
+    # mathematically unfirable (review finding)
+    mon = _mon(regression_factor=5.0)
+    for step in range(1, 6):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+    fired = mon.ingest(0, {'step': 6, 'wall_ms': 600.0})
+    kinds = [k for k, _ in fired]
+    assert 'fleet.step_regression' in kinds, fired
+    info = dict(fired)['fleet.step_regression']
+    assert info['baseline_ms'] == 100.0 and info['factor'] == 6.0
+
+
+def test_comm_imbalance_flag_clears_when_offender_changes():
+    mon = _mon(imbalance_factor=1.5)
+    for step in range(1, 4):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0,
+                       'comm_bytes': {'dp': 1000 * step}})
+        mon.ingest(1, {'step': step, 'wall_ms': 100.0,
+                       'comm_bytes': {'dp': 5000 * step}})
+    assert 'fleet.comm_imbalance' in mon.ranks[1].flags
+    # traffic shifts: rank 0 becomes the heavy one — rank 1's flag
+    # must clear (a stuck flag would latch-swallow its next offense)
+    fired = []
+    for step in range(4, 8):
+        fired += mon.ingest(0, {'step': step, 'wall_ms': 100.0,
+                                'comm_bytes': {'dp': 3000 + 50000 * step}})
+        fired += mon.ingest(1, {'step': step, 'wall_ms': 100.0,
+                                'comm_bytes': {'dp': 15000 + 1000 * step}})
+    assert 'fleet.comm_imbalance' not in mon.ranks[1].flags
+    hits = [i for k, i in fired if k == 'fleet.comm_imbalance']
+    assert hits and hits[-1]['rank'] == 0
+
+
+def test_refresh_after_removal_does_not_resurrect_rows():
+    telemetry.enable()
+    mon = _mon()
+    fleet._monitor = mon
+    mon.ingest(0, {'step': 1, 'wall_ms': 100.0})
+    mon.ingest(1, {'step': 1, 'wall_ms': 100.0})
+    mon.remove_ranks([1])
+    mon.refresh_gauges()
+    assert telemetry.value('mxnet_tpu_fleet_snapshot_age_seconds',
+                           rank=1) is None
+    assert telemetry.value('mxnet_tpu_fleet_ranks') == 1
+
+
+def test_loss_spike_detector():
+    mon = _mon(loss_spike_sigma=6.0)
+    fired = []
+    for step in range(1, 13):
+        fired += mon.ingest(0, {'step': step, 'wall_ms': 100.0,
+                                'loss': 1.0 + 0.01 * (step % 3)})
+    assert not [k for k, _ in fired if k == 'fleet.loss_spike']
+    fired = mon.ingest(0, {'step': 13, 'wall_ms': 100.0, 'loss': 50.0})
+    assert [k for k, _ in fired] == ['fleet.loss_spike']
+    info = dict(fired)['fleet.loss_spike']
+    assert info['rank'] == 0 and info['sigma'] >= 6.0
+
+
+def test_loss_spike_fires_from_flat_baseline():
+    # std == 0 (identical losses) is where a jump is MOST anomalous —
+    # the zero-std guard must not make the detector unfirable
+    mon = _mon(loss_spike_sigma=6.0)
+    for step in range(1, 11):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0, 'loss': 1.0})
+    fired = mon.ingest(0, {'step': 11, 'wall_ms': 100.0, 'loss': 100.0})
+    assert [k for k, _ in fired] == ['fleet.loss_spike'], fired
+
+
+def test_comm_imbalance_detector():
+    mon = _mon(imbalance_factor=1.5)
+    fired = []
+    for step in range(1, 4):
+        fired += mon.ingest(0, {'step': step, 'wall_ms': 100.0,
+                                'comm_bytes': {'dp': 1000 * step}})
+        fired += mon.ingest(1, {'step': step, 'wall_ms': 100.0,
+                                'comm_bytes': {'dp': 5000 * step}})
+    hits = [i for k, i in fired if k == 'fleet.comm_imbalance']
+    assert hits and hits[0]['rank'] == 1 and hits[0]['ratio'] >= 4.9
+
+
+def test_anomalies_emit_flight_notes_and_metrics():
+    telemetry.enable()
+    trace.enable()                    # flight notes require the tracer
+    mon = _mon(straggler_factor=1.5)
+    for step in range(1, 6):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+        mon.ingest(1, {'step': step, 'wall_ms': 400.0})
+    notes = [e for e in flight.get().events()
+             if e['kind'] == 'fleet.straggler']
+    assert notes and notes[0]['rank'] == 1
+    assert telemetry.value('mxnet_tpu_fleet_anomalies_total',
+                           kind='fleet.straggler', rank=1) >= 1
+    assert telemetry.value('mxnet_tpu_fleet_ranks') == 2
+    assert telemetry.value('mxnet_tpu_fleet_step_ms', rank=1) == 400.0
+
+
+def test_fleet_comm_gauge_mirrors_rank_totals():
+    telemetry.enable()
+    mon = _mon()
+    mon.ingest(1, {'step': 1, 'wall_ms': 10.0,
+                   'comm_bytes': {'dp': 1234}})
+    mon.ingest(1, {'step': 2, 'wall_ms': 10.0,
+                   'comm_bytes': {'dp': 2468}})
+    assert telemetry.value('mxnet_tpu_fleet_comm_bytes',
+                           rank=1, axis='dp') == 2468
+    v = mon.view()
+    assert v['ranks'][1]['comm_bytes_total'] == {'dp': 2468}
+    assert v['ranks'][1]['comm_bytes_per_step'] == {'dp': 1234}
+
+
+# ---------------------------------------------------------------------------
+# membership piggyback wiring
+# ---------------------------------------------------------------------------
+
+def test_attach_pipes_snapshots_to_coordinator_monitor():
+    telemetry.enable()
+    trace.enable()
+    port = _free_port()
+    ms0 = dist.Membership(0, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    ms0.start()
+    ms1 = dist.Membership(1, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    try:
+        mon = fleet.attach(ms0)
+        assert fleet.attach(ms1) is None         # workers get no monitor
+        with trace.span('step.dispatch'):
+            pass
+        flight.get().record_step(1)
+        ms0.beat()
+        ms1.beat()
+        assert _wait_until(
+            lambda: sorted(mon.view()['ranks']) == [0, 1]), mon.view()
+        snaps = ms0.fleet_snapshots()
+        assert set(snaps) == {0, 1}
+        assert snaps[1]['snap']['step'] == 1
+    finally:
+        fleet.detach(ms0)
+        fleet.detach(ms1)
+        ms0.stop()
+        ms1.stop()
+
+
+def test_removed_rank_gauge_rows_are_retired():
+    telemetry.enable()
+    mon = _mon()
+    mon.ingest(0, {'step': 1, 'wall_ms': 100.0, 'loss': 1.0})
+    mon.ingest(1, {'step': 1, 'wall_ms': 300.0, 'loss': 1.2,
+                   'comm_bytes': {'dp': 10}})
+    assert telemetry.value('mxnet_tpu_fleet_step_ms', rank=1) == 300.0
+    mon.remove_ranks([1])
+    # every per-rank series of the departed rank is gone from scrapes
+    # (a frozen ghost row would read as "perfectly fresh" forever)
+    for name in ('mxnet_tpu_fleet_step_ms', 'mxnet_tpu_fleet_last_step',
+                 'mxnet_tpu_fleet_loss',
+                 'mxnet_tpu_fleet_snapshot_age_seconds'):
+        assert telemetry.value(name, rank=1) is None, name
+    assert not [lb for lb, _v in
+                telemetry.series('mxnet_tpu_fleet_comm_bytes')
+                if lb.get('rank') == '1']
+    assert telemetry.value('mxnet_tpu_fleet_step_ms', rank=0) == 100.0
+    assert telemetry.value('mxnet_tpu_fleet_ranks') == 1
+
+
+def test_worker_stall_verdict_reads_reply_straggler():
+    telemetry.enable()
+    trace.enable()
+    port = _free_port()
+    ms0 = dist.Membership(0, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    ms0.start()
+    ms1 = dist.Membership(1, 2, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    try:
+        mon = fleet.attach(ms0)
+        fleet.attach(ms1)
+        # flag rank 1 as the slow straggler on the coordinator
+        for step in range(1, 6):
+            mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+            mon.ingest(1, {'step': step, 'wall_ms': 400.0})
+        assert mon.straggler()['rank'] == 1
+        ms1.beat()                    # reply carries the summary
+        assert (ms1.view() or {}).get('straggler', {}).get('rank') == 1
+        # a WORKER's watchdog (no local monitor) must still name the
+        # suspect — (world-1)/world of wedges happen off-coordinator
+        fleet._monitor = None
+        v = stall_verdict(ms1)
+        assert v['verdict'] == 'straggler_suspected', v
+        assert v['straggler']['rank'] == 1 and v['straggler']['flagged']
+        report = StepWatchdog(deadline_seconds=999.0, membership=ms1
+                              )._format_report(1.0, 5)
+        assert 'STRAGGLER SUSPECTED: rank 1' in report
+    finally:
+        fleet.detach(ms0)
+        fleet.detach(ms1)
+        ms0.stop()
+        ms1.stop()
+
+
+def test_removed_rank_is_evicted_not_latched_stale():
+    # a departed rank must not haunt the straggler verdict: without
+    # eviction its snapshot age only grows and the 'stale' flag could
+    # never clear (review finding on the PR-8 re-form path)
+    mon = _mon(stale_seconds=0.05)
+    mon.ingest(0, {'step': 1, 'wall_ms': 100.0})
+    mon.ingest(1, {'step': 1, 'wall_ms': 100.0})
+    time.sleep(0.12)
+    mon.ingest(0, {'step': 2, 'wall_ms': 100.0})
+    assert mon.straggler()['rank'] == 1          # latched stale
+    mon.remove_ranks([1])
+    assert mon.straggler() is None
+    assert sorted(mon.view()['ranks']) == [0]
+
+
+def test_remove_peers_evicts_rank_from_monitor():
+    telemetry.enable()
+    trace.enable()
+    port = _free_port()
+    ms0 = dist.Membership(0, 3, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    ms0.start()
+    ms1 = dist.Membership(1, 3, port=port, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    try:
+        mon = fleet.attach(ms0)
+        fleet.attach(ms1)
+        with trace.span('step.dispatch'):
+            pass
+        flight.get().record_step(1)
+        ms0.beat()
+        ms1.beat()
+        assert _wait_until(
+            lambda: sorted(mon.view()['ranks']) == [0, 1]), mon.view()
+        # both the coordinator's own call and a worker's request route
+        # through the on_peers_removed hook
+        ms0.remove_peers([1])
+        assert sorted(mon.view()['ranks']) == [0]
+        assert 1 not in ms0.fleet_snapshots()
+    finally:
+        fleet.detach(ms0)
+        fleet.detach(ms1)
+        ms0.stop()
+        ms1.stop()
+
+
+def test_become_coordinator_reattaches_fleet():
+    port0, port1 = _free_port(), _free_port()
+    ms1 = dist.Membership(1, 2, port=port0, heartbeat_seconds=0.1,
+                          deadline_seconds=30.0, start=False)
+    try:
+        assert fleet.attach(ms1) is None         # worker: provider only
+        assert ms1.telemetry_provider is not None
+        assert ms1.on_snapshot is None
+        ms1.port = port1                         # promote on a free port
+        ms1.become_coordinator()
+        # promotion made this rank the merge point: monitor created,
+        # snapshots ingested, removals mirrored
+        assert ms1.on_snapshot is not None
+        assert fleet.monitor() is not None
+        assert ms1.on_peers_removed is not None
+    finally:
+        ms1.stop()
+
+
+def test_export_writes_only_ingesting_ranks_gauges():
+    telemetry.enable()
+    mon = _mon()
+    mon.ingest(0, {'step': 1, 'wall_ms': 100.0})
+    mon.ingest(1, {'step': 1, 'wall_ms': 300.0})
+    # rank 1's ingest must not rewrite rank 0's skew against the new
+    # median — rank 0's row refreshes on ITS next beat (O(world) per
+    # heartbeat period, not O(world^2))
+    skew0 = telemetry.value('mxnet_tpu_fleet_step_skew_ms', rank=0)
+    skew1 = telemetry.value('mxnet_tpu_fleet_step_skew_ms', rank=1)
+    assert skew0 == 0.0          # written when rank 0 was alone
+    assert skew1 == 100.0        # vs median(100, 300) = 200
+    mon.ingest(0, {'step': 2, 'wall_ms': 100.0})
+    assert telemetry.value('mxnet_tpu_fleet_step_skew_ms',
+                           rank=0) == -100.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+def test_server_endpoints_and_404():
+    telemetry.enable()
+    trace.enable()
+    telemetry.inc('mxnet_tpu_steps_total')
+    with trace.span('step.dispatch'):
+        pass
+    flight.get().record_step(1)
+    srv = server.TelemetryServer(port=0)
+    base = f'http://127.0.0.1:{srv.port}'
+    try:
+        code, body = _get(base + '/metrics')
+        assert code == 200 and 'mxnet_tpu_steps_total 1' in body
+        code, body = _get(base + '/healthz')
+        assert code == 200
+        doc = json.loads(body)
+        assert doc['status'] == 'ok' and doc['telemetry'] is True
+        assert doc['last_step'] == 1
+        code, body = _get(base + '/flight')
+        assert code == 200
+        doc = json.loads(body)
+        assert doc['steps'][0]['step'] == 1
+        assert 'traceEvents' in doc
+        code, body = _get(base + '/nope')
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_server_bounded_handlers_shed_load():
+    srv = server.TelemetryServer(port=0, max_handlers=2)
+    base = f'http://127.0.0.1:{srv.port}'
+    results = []
+
+    def hit():
+        try:
+            results.append(_get(base + '/metrics', timeout=5)[0])
+        except Exception as e:
+            results.append(repr(e))
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # the server survives the storm: some requests answered, the
+        # rest shed (connection reset), and it still answers afterwards
+        assert any(r == 200 for r in results), results
+        assert _get(base + '/metrics')[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_trickling_client_cannot_hold_a_slot_past_deadline():
+    # a client feeding one byte per interval resets the socket timeout
+    # every recv — the per-request wall deadline must still cut it off
+    # so it cannot starve the bounded handler pool (slow-loris)
+    srv = server.TelemetryServer(port=0, max_handlers=2)
+    try:
+        s = socket.create_connection(('127.0.0.1', srv.port), timeout=5)
+        t0 = time.monotonic()
+        s.sendall(b'G')
+        closed = False
+        while time.monotonic() - t0 < 10.0:
+            time.sleep(0.3)
+            try:
+                s.sendall(b'X')
+            except OSError:
+                closed = True
+                break
+        assert closed, "trickling connection survived the deadline"
+        assert time.monotonic() - t0 < 9.0
+        s.close()
+        assert _get(f'http://127.0.0.1:{srv.port}/metrics')[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_healthz_reports_last_committed_step(tmp_path):
+    import numpy as onp
+    mgr = checkpoint.CheckpointManager(str(tmp_path), async_save=False,
+                                       replication=False)
+    mgr.save(7, params={'w': onp.zeros(4, onp.float32)}, block=True)
+    srv = server.TelemetryServer(port=0)
+    try:
+        doc = json.loads(_get(f'http://127.0.0.1:{srv.port}/healthz')[1])
+        assert doc['last_committed_step'] == 7
+        assert checkpoint.last_committed_step() == 7
+    finally:
+        srv.stop()
+        mgr.close()
+
+
+def test_server_knob_gate(monkeypatch):
+    monkeypatch.delenv('MXTPU_METRICS_PORT', raising=False)
+    assert server.maybe_start(rank=0) is None
+    port = _free_port()
+    monkeypatch.setenv('MXTPU_METRICS_PORT', str(port))
+    srv = server.maybe_start(rank=0)
+    try:
+        assert srv is not None and srv.port == port
+        assert server.start(rank=0) is srv       # idempotent
+    finally:
+        server.stop()
+
+
+def test_scrape_refreshes_silent_ranks_age_gauge():
+    telemetry.enable()
+    mon = _mon()
+    fleet._monitor = mon
+    mon.ingest(0, {'step': 1, 'wall_ms': 100.0})
+    mon.ingest(1, {'step': 1, 'wall_ms': 100.0})
+    # rank 1 goes SILENT: its age gauge froze at ~0 (stamped by its own
+    # last ingest) — the /metrics scrape must re-export a GROWING age,
+    # or an alert on it can never fire for the rank that matters
+    time.sleep(0.15)
+    mon.ingest(0, {'step': 2, 'wall_ms': 100.0})
+    frozen = telemetry.value('mxnet_tpu_fleet_snapshot_age_seconds',
+                             rank=1)
+    assert frozen is not None and frozen < 0.1
+    srv = server.TelemetryServer(port=0)
+    try:
+        body = _get(f'http://127.0.0.1:{srv.port}/metrics')[1]
+    finally:
+        srv.stop()
+    age = telemetry.value('mxnet_tpu_fleet_snapshot_age_seconds', rank=1)
+    assert age >= 0.15, age
+    assert 'mxnet_tpu_fleet_snapshot_age_seconds{rank="1"}' in body
+
+
+def test_thread_exhaustion_releases_handler_slot(monkeypatch):
+    srv = server.TelemetryServer(port=0, max_handlers=2)
+    base = f'http://127.0.0.1:{srv.port}'
+    try:
+        assert _get(base + '/metrics')[0] == 200
+
+        class _Unstartable:
+            def __init__(self, *a, **kw):
+                pass
+
+            def start(self):
+                raise RuntimeError("can't start new thread")
+        # every accept during the outage must give its pool slot BACK —
+        # a leak would brick the endpoint after max_handlers failures
+        monkeypatch.setattr(server.threading, 'Thread', _Unstartable)
+        for _ in range(8):
+            try:
+                _get(base + '/metrics', timeout=2)
+            except Exception:
+                pass
+        monkeypatch.undo()
+        time.sleep(0.1)
+        assert _get(base + '/metrics')[0] == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# straggler verdict (watchdog upgrade)
+# ---------------------------------------------------------------------------
+
+class _FakeMembership:
+    rank = 0
+    deadline_seconds = 10.0
+
+    def lost_peers(self):
+        return []
+
+    def peer_ages(self):
+        return {1: 0.1}
+
+    def clock_offset(self):
+        return (0.0, 0.0)
+
+
+def test_stall_verdict_upgrades_to_straggler_suspected():
+    mon = _mon(straggler_factor=1.5)
+    for step in range(1, 6):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+        mon.ingest(1, {'step': step, 'wall_ms': 400.0})
+    fleet._monitor = mon
+    v = stall_verdict(_FakeMembership())
+    assert v['verdict'] == 'straggler_suspected'
+    assert v['straggler']['rank'] == 1 and v['straggler']['flagged']
+    report = StepWatchdog(deadline_seconds=999.0,
+                          membership=_FakeMembership()
+                          )._format_report(1.0, 5)
+    assert 'STRAGGLER SUSPECTED: rank 1' in report
+    assert 'last snapshot' in report
+
+
+def test_stall_verdict_local_stall_names_worst_rank_unflagged():
+    mon = _mon(straggler_factor=10.0)     # threshold never trips
+    for step in range(1, 6):
+        mon.ingest(0, {'step': step, 'wall_ms': 100.0})
+        mon.ingest(1, {'step': step, 'wall_ms': 130.0})
+    fleet._monitor = mon
+    v = stall_verdict(_FakeMembership())
+    assert v['verdict'] == 'local_stall'
+    s = v['straggler']
+    assert s['rank'] == 1 and not s['flagged']   # worst-of-fleet hint
+    report = StepWatchdog(deadline_seconds=999.0,
+                          membership=_FakeMembership()
+                          )._format_report(1.0, 5)
+    assert 'LOCAL STALL' in report and 'worst rank: 1' in report
+
+
+# ---------------------------------------------------------------------------
+# disarmed cost: zero-alloc on the step path (the PR 6 discipline)
+# ---------------------------------------------------------------------------
+
+def test_disarmed_fleet_paths_allocate_nothing():
+    assert not trace.enabled() and not telemetry.enabled()
+
+    def hot_loop(n):
+        for _ in range(n):
+            with trace.span('step.dispatch'):
+                pass
+            flight.record_step(1)
+            fleet.local_snapshot()
+    hot_loop(64)                       # warm lazy interpreter state
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    hot_loop(2000)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(d.size_diff for d in after.compare_to(before, 'filename')
+                if d.size_diff > 0)
+    assert grown < 4096, f"disarmed fleet path leaked {grown} bytes"
+    assert flight.get().steps() == []
+
+
+# ---------------------------------------------------------------------------
+# flight-dir routing (the CWD-litter fix)
+# ---------------------------------------------------------------------------
+
+def test_flight_default_path_not_cwd(monkeypatch, tmp_path):
+    monkeypatch.delenv('MXTPU_FLIGHT_PATH', raising=False)
+    monkeypatch.delenv('MXTPU_FLIGHT_DIR', raising=False)
+    p = flight.default_dump_path()
+    assert os.path.isabs(p)
+    assert os.path.dirname(p) != os.getcwd()
+    assert f'mxtpu_flight-{os.getpid()}.json' in p
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    assert flight.default_dump_path().startswith(str(tmp_path))
+    monkeypatch.setenv('MXTPU_FLIGHT_PATH', str(tmp_path / 'x.json'))
+    assert flight.default_dump_path() == str(tmp_path / 'x.json')
+
+
+def test_flight_dump_lands_in_flight_dir(monkeypatch, tmp_path):
+    monkeypatch.delenv('MXTPU_FLIGHT_PATH', raising=False)
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    trace.enable()
+    with trace.span('step.dispatch'):
+        pass
+    flight.get().record_step(1)
+    path = flight.dump(reason='test')
+    assert path and path.startswith(str(tmp_path)), path
+    assert json.load(open(path))['reason'] == 'test'
+
+
+# ---------------------------------------------------------------------------
+# trace stitching
+# ---------------------------------------------------------------------------
+
+def _rank_doc(rank, offset_us, t0=1_000_000.0, open_span=False):
+    evs = [
+        {'name': 'thread_name', 'ph': 'M', 'pid': 1, 'tid': 1,
+         'args': {'name': 'main'}},
+        {'name': 'step.dispatch', 'cat': 'span', 'ph': 'B',
+         'ts': t0, 'tid': 1},
+        {'name': 'step.dispatch', 'cat': 'span', 'ph': 'E',
+         'ts': t0 + 500.0, 'tid': 1},
+    ]
+    if open_span:
+        evs.append({'name': 'step.compiled', 'cat': 'span', 'ph': 'B',
+                    'ts': t0 + 600.0, 'tid': 1})
+        evs.append({'name': 'step.compiled', 'cat': 'span', 'ph': 'E',
+                    'ts': t0 + 700.0, 'tid': 1,
+                    'args': {'flushed': True}})
+    return {'traceEvents': evs, 'rank': rank,
+            'clock_offset_us': offset_us}
+
+
+def test_stitch_traces_shifts_remaps_and_validates(tmp_path):
+    p0 = tmp_path / 'r0.json'
+    p1 = tmp_path / 'r1.json'
+    out = tmp_path / 'fleet.json'
+    json.dump(_rank_doc(0, 0.0), open(p0, 'w'))
+    json.dump(_rank_doc(1, 2500.0, open_span=True), open(p1, 'w'))
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'stitch_traces.py'),
+         '-o', str(out), str(p0), str(p1)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # the wedged rank's open span is called out on the shared timeline
+    assert 'OPEN at dump time' in r.stdout and 'rank 1' in r.stdout
+    doc = json.load(open(out))
+    assert doc['stitch']['ranks'] == [0, 1]
+    by_pid = {}
+    for e in doc['traceEvents']:
+        if e.get('ph') == 'B' and e['name'] == 'step.dispatch':
+            by_pid[e['pid']] = e['ts']
+    # rank 1's events were shifted into the coordinator timebase
+    assert by_pid[1] - by_pid[0] == 2500.0
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'check_trace.py'),
+         str(out)], capture_output=True, text=True)
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+
+
+def test_stitch_rejects_duplicate_ranks(tmp_path):
+    p0 = tmp_path / 'a.json'
+    p1 = tmp_path / 'b.json'
+    json.dump(_rank_doc(0, 0.0), open(p0, 'w'))
+    json.dump(_rank_doc(0, 0.0), open(p1, 'w'))
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'stitch_traces.py'),
+         '-o', str(tmp_path / 'o.json'), str(p0), str(p1)],
+        capture_output=True, text=True)
+    assert r.returncode == 2
+    assert 'duplicate ranks' in r.stderr
+
+
+def test_dump_rank_trace_embeds_rank_and_offset(tmp_path):
+    trace.enable()
+    with trace.span('step.dispatch'):
+        pass
+    path = str(tmp_path / 'rank.json')
+    fleet.dump_rank_trace(path, membership=None)
+    doc = json.load(open(path))
+    assert doc['rank'] == 0 and doc['clock_offset_us'] == 0.0
+    assert any(e.get('name') == 'step.dispatch'
+               for e in doc['traceEvents'])
+
+
+# ---------------------------------------------------------------------------
+# the two-process drill (acceptance): endpoints on both ranks, fleet
+# view with skew, injected straggler flagged + named, comm agreement,
+# stitched trace clean
+# ---------------------------------------------------------------------------
+
+def test_fleet_drill_end_to_end(tmp_path):
+    from mxnet_tpu.resilience.drill import run_fleet_drill
+    result = run_fleet_drill(str(tmp_path))
+    assert result['ok']
+    assert result['straggler']['rank'] == result['slow_rank'] == 1
+    assert 'STRAGGLER SUSPECTED: rank 1' in result['watchdog_verdict']
+    assert result['comm_agreement'] and \
+        all(v > 0 for v in result['comm_agreement'].values())
+    assert result['skew_ms'] > 0
+    assert 0 < min(result['snapshot_bytes'].values()) <= \
+        max(result['snapshot_bytes'].values()) < 2048
+    assert os.path.exists(result['stitched'])

@@ -337,6 +337,37 @@ def test_registry_drift_unknown_fault_site_and_span(tmp_path):
     assert syms == {'io.bogus_site', 'step.bogus'}, found
 
 
+def test_registry_drift_unknown_flight_note_kind(tmp_path):
+    idx = make_index(tmp_path, {'mod.py': '''
+        from telemetry import flight as _flight
+
+        def _note(kind, **info):
+            _flight.note(kind, **info)
+
+        def f():
+            _flight.note('fleet.straggler', rank=1)
+            _flight.note('fleet.bogus_event', rank=1)
+            _note('checkpoint.scrub', step=3)
+            _note('checkpoint.bogus', step=3)
+    '''})
+    rule = RegistryDriftRule(fault_sites=set(), span_names=set(),
+                             note_names={'fleet.straggler',
+                                         'checkpoint.scrub'},
+                             check_metrics=False)
+    found = rule.run(idx)
+    syms = {f.symbol for f in found}
+    assert syms == {'fleet.bogus_event', 'checkpoint.bogus'}, found
+
+
+def test_registry_drift_fleet_contract_declared():
+    # the fleet namespace + note kinds are part of the shared contract
+    from mxtpu_lint import contracts
+    assert 'mxnet_tpu_fleet_' in contracts.SUBSYSTEM_METRICS
+    assert {'fleet.straggler', 'fleet.step_regression',
+            'fleet.loss_spike', 'fleet.comm_imbalance'} <= \
+        contracts.FLIGHT_NOTE_NAMES
+
+
 def test_registry_drift_fault_sites_parsed_from_registry(tmp_path):
     idx = make_index(tmp_path, {
         'resilience/faults.py': '''

@@ -125,6 +125,31 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_trace_ring_depth': 'gauge',
         'mxnet_tpu_trace_flight_dumps_total': 'counter',
     },
+    'mxnet_tpu_fleet_': {
+        # fleet observability (ISSUE 13): the coordinator's merged view
+        # of every rank's heartbeat-piggybacked telemetry snapshot.
+        # Per-rank gauges carry a `rank` label; skew is against the
+        # fleet median of the last reported step wall times; the
+        # comm-bytes counter mirrors each rank's per-hop accounting
+        # (axis label) so a fleet dashboard reads one endpoint.
+        'mxnet_tpu_fleet_ranks': 'gauge',
+        'mxnet_tpu_fleet_last_step': 'gauge',
+        'mxnet_tpu_fleet_step_ms': 'gauge',
+        'mxnet_tpu_fleet_step_skew_ms': 'gauge',
+        'mxnet_tpu_fleet_step_seconds': 'histogram',
+        'mxnet_tpu_fleet_loss': 'gauge',
+        'mxnet_tpu_fleet_clock_offset_seconds': 'gauge',
+        'mxnet_tpu_fleet_snapshot_age_seconds': 'gauge',
+        'mxnet_tpu_fleet_snapshots_total': 'counter',
+        # mirrors each rank's own cumulative
+        # mxnet_tpu_comm_collective_bytes_total by hop axis (gauge: the
+        # value IS the remote counter's, so the two scrapes agree
+        # exactly — dryrun_multichip asserts it)
+        'mxnet_tpu_fleet_comm_bytes': 'gauge',
+        # streaming anomaly detectors (kind + rank labels): straggler
+        # skew / step-time regression / loss spike / comm imbalance
+        'mxnet_tpu_fleet_anomalies_total': 'counter',
+    },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
         'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
@@ -192,6 +217,33 @@ SPAN_NAMES = frozenset({
     'sync.lease_drain',
     # resilience
     'guard.rollback', 'elastic.reform',
+})
+
+# ---------------------------------------------------------------------------
+# flight-recorder note kinds (registry-drift rule). A ``flight.note``
+# literal not in this contract is either a typo or a new event class
+# the post-mortem tooling (watchdog reports, fleet dashboards, docs)
+# has never heard of — declare it here when adding the emission site.
+# The fleet detector notes are emitted through a variable (the
+# detector return tuples in telemetry/fleet.py), so they are declared
+# here as the canonical enumeration.
+# ---------------------------------------------------------------------------
+
+FLIGHT_NOTE_NAMES = frozenset({
+    # fault injection + non-finite guard
+    'fault', 'guard.bad_step', 'guard.rollback',
+    # watchdog
+    'watchdog.stall',
+    # elastic membership / re-form controller
+    'elastic.peer_loss', 'elastic.peer_loss_suspected',
+    'elastic.preempt_exit', 'elastic.reform',
+    # checkpoint replication + scrubbing
+    'checkpoint.replicated', 'checkpoint.replica_failed',
+    'checkpoint.replica_dropped', 'checkpoint.replica_restore',
+    'checkpoint.scrub', 'checkpoint.repair',
+    # fleet anomaly detectors (ISSUE 13)
+    'fleet.straggler', 'fleet.step_regression', 'fleet.loss_spike',
+    'fleet.comm_imbalance',
 })
 
 # ---------------------------------------------------------------------------

@@ -10,6 +10,10 @@ Three contracts, one rule:
 - **span names**: every ``span('<name>')``/``instant('<name>')``
   literal must be declared in ``contracts.SPAN_NAMES`` — the
   attribution bucketing and docs enumerate that set.
+- **flight-note kinds**: every ``flight.note('<kind>')`` literal
+  (including module-local ``_note`` wrappers around it) must be
+  declared in ``contracts.FLIGHT_NOTE_NAMES`` — post-mortem tooling
+  and fleet dashboards grep dumps by these strings.
 - **telemetry metric names**: every instrumentation-site literal must
   be ``mxnet_tpu_*`` lowercase_snake, registered under exactly one
   kind, and consistent with ``contracts.SUBSYSTEM_METRICS``
@@ -117,11 +121,14 @@ class RegistryDriftRule(LintRule):
            'must match their registry or contract')
 
     def __init__(self, fault_sites=None, span_names=None,
-                 check_metrics=True):
+                 note_names=None, check_metrics=True):
         self._fault_sites = fault_sites
         self.span_names = (frozenset(span_names)
                            if span_names is not None
                            else contracts.SPAN_NAMES)
+        self.note_names = (frozenset(note_names)
+                           if note_names is not None
+                           else contracts.FLIGHT_NOTE_NAMES)
         self.check_metrics = check_metrics
 
     def run(self, index: FileIndex):
@@ -155,6 +162,15 @@ class RegistryDriftRule(LintRule):
                             f"tools/mxtpu_lint/contracts.py SPAN_NAMES "
                             f"— attribution and docs have never heard "
                             f"of it", symbol=lit))
+                elif leaf in ('note', '_note') and \
+                        self._is_flight_note_call(sf, node):
+                    if lit not in self.note_names:
+                        findings.append(self.finding(
+                            sf, node.lineno,
+                            f"flight-note kind {lit!r} is not declared "
+                            f"in tools/mxtpu_lint/contracts.py "
+                            f"FLIGHT_NOTE_NAMES — post-mortem tooling "
+                            f"greps dumps by these strings", symbol=lit))
         if self.check_metrics:
             _names, errors = scan_metrics(index)
             for relpath, lineno, name, problem in errors:
@@ -175,6 +191,23 @@ class RegistryDriftRule(LintRule):
                 isinstance(func.value, ast.Name):
             mod = sf.imports.get(func.value.id, func.value.id)
             return mod.endswith('faults') or 'faults' in func.value.id
+        return False
+
+    @staticmethod
+    def _is_flight_note_call(sf, node) -> bool:
+        """flight.note(...) / _flight.note(...) / self.note inside
+        flight.py / a module-local ``_note`` wrapper in a file that
+        imports the flight recorder."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id not in ('note', '_note'):
+                return False
+            return sf.relpath.endswith('telemetry/flight.py') or any(
+                str(v).endswith('flight') for v in sf.imports.values())
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            mod = sf.imports.get(func.value.id, func.value.id)
+            return str(mod).endswith('flight') or 'flight' in func.value.id
         return False
 
     @staticmethod

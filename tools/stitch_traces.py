@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Stitch per-rank chrome traces into one fleet-wide timeline.
+
+Every rank of a multi-host job dumps its own trace (flight-recorder
+dumps and ``telemetry.fleet.dump_rank_trace`` both embed balanced
+``traceEvents``); each runs on its own wall clock, so naively
+concatenating them smears the timeline by the inter-host clock skew.
+The membership layer already measures that skew: every heartbeat
+round-trip yields a ``(rtt, offset)`` sample against the coordinator's
+clock, and ``Membership.clock_offset()`` keeps the minimum-RTT
+estimate (error bounded by rtt/2 — microseconds on a LAN, far tighter
+than the millisecond spans being aligned). ``fleet.dump_rank_trace``
+stamps each dump with its ``rank`` and ``clock_offset_us``; this tool
+
+1. shifts every event's ``ts`` into the coordinator timebase
+   (``ts + clock_offset_us``),
+2. remaps ``pid`` to the rank (with ``process_name`` metadata), so
+   per-rank thread stacks stay distinct and chrome://tracing shows one
+   row group per rank,
+3. merges, sorts, and validates the result with the same structural
+   checker as ``tools/check_trace.py`` — the stitched dump is only
+   written when it is check_trace-clean.
+
+One wedged rank's still-open span (closed synthetically with
+``{'flushed': True}`` at dump time) therefore lands on the shared
+timeline next to every healthy rank's steps — the "who is the
+straggler" question becomes a picture.
+
+Run::
+
+    python tools/stitch_traces.py -o fleet_trace.json \
+        rank0.json rank1.json [...]
+
+Inputs may be ``dump_rank_trace`` files, flight-recorder dumps, or any
+``{'traceEvents': [...]}`` doc; files without an embedded ``rank`` get
+their argv position, files without ``clock_offset_us`` get 0 (pass
+``--offset-us PATH=MICROS`` to supply one measured elsewhere).
+
+Standalone by design: imports nothing from mxnet_tpu (a trace scraped
+off a fleet stitches on any laptop).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    from mxtpu_lint import artifacts as _artifacts
+except ImportError:                      # run from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxtpu_lint import artifacts as _artifacts
+
+
+def load_rank_doc(path, default_rank=0):
+    """(rank, offset_us, events, meta) from one per-rank dump."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        doc = {'traceEvents': doc}
+    events = doc.get('traceEvents')
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents list")
+    rank = doc.get('rank', default_rank)
+    offset_us = float(doc.get('clock_offset_us', 0.0))
+    meta = {'path': path, 'rank': int(rank),
+            'clock_offset_us': offset_us,
+            'clock_rtt_us': doc.get('clock_rtt_us'),
+            'events': len(events)}
+    return int(rank), offset_us, events, meta
+
+
+def stitch(rank_docs):
+    """Merge ``[(rank, offset_us, events), ...]`` into one stitched
+    traceEvents list (coordinator timebase, pid = rank)."""
+    merged = []
+    metadata = []
+    flushed = []
+    for rank, offset_us, events in rank_docs:
+        metadata.append({'name': 'process_name', 'ph': 'M', 'pid': rank,
+                         'tid': 0, 'args': {'name': f'rank {rank}'}})
+        shifted = []
+        for ev in events:
+            ev = dict(ev, pid=rank)
+            if ev.get('ph') == 'M':
+                metadata.append(ev)
+                continue
+            if 'ts' in ev:
+                ev['ts'] = float(ev['ts']) + offset_us
+            shifted.append(ev)
+            if ev.get('ph') == 'E' and \
+                    (ev.get('args') or {}).get('flushed'):
+                flushed.append((rank, ev.get('name'), ev.get('tid')))
+        merged.append(shifted)
+    events = [e for evs in merged for e in evs]
+    # stable sort: per-rank order is already stack-consistent; ties
+    # across ranks resolve by input order, which never changes
+    events.sort(key=lambda e: e.get('ts', 0.0))
+    return metadata + events, flushed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="stitch per-rank chrome traces into one timeline")
+    ap.add_argument('inputs', nargs='+', help='per-rank trace dumps')
+    ap.add_argument('-o', '--output', default='fleet_trace.json')
+    ap.add_argument('--offset-us', action='append', default=[],
+                    metavar='PATH=MICROS',
+                    help='override/supply a clock offset for one input')
+    args = ap.parse_args(argv)
+    overrides = {}
+    for spec in args.offset_us:
+        path, _, val = spec.partition('=')
+        overrides[os.path.normpath(path)] = float(val)
+
+    docs, metas = [], []
+    for i, path in enumerate(args.inputs):
+        try:
+            rank, offset_us, events, meta = load_rank_doc(path, i)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"{path}: unreadable: {e}", file=sys.stderr)
+            return 2
+        offset_us = overrides.get(os.path.normpath(path), offset_us)
+        meta['clock_offset_us'] = offset_us
+        docs.append((rank, offset_us, events))
+        metas.append(meta)
+    ranks = [r for r, _o, _e in docs]
+    if len(set(ranks)) != len(ranks):
+        print(f"duplicate ranks in inputs: {ranks} — pass each rank's "
+              f"dump once", file=sys.stderr)
+        return 2
+
+    events, flushed = stitch(docs)
+    errors = _artifacts.check_trace_events(events)
+    if errors:
+        for e in errors:
+            print(f"stitched stream invalid: {e}", file=sys.stderr)
+        return 1
+    out = {'traceEvents': events, 'displayTimeUnit': 'ms',
+           'stitch': {'ranks': sorted(set(ranks)), 'inputs': metas}}
+    tmp = args.output + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(out, f)
+    os.replace(tmp, args.output)
+
+    spans = sum(1 for e in events if e.get('ph') == 'B')
+    print(f"{args.output}: OK — {len(events)} events, {spans} spans "
+          f"across ranks {sorted(set(ranks))}, offsets "
+          f"{ {m['rank']: round(m['clock_offset_us'], 1) for m in metas} }"
+          f" us")
+    for rank, name, tid in flushed:
+        print(f"  rank {rank}: span {name!r} (tid {tid}) was still OPEN "
+              f"at dump time — the prime wedge suspect")
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
