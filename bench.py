@@ -444,6 +444,53 @@ def _zero_report(step, timeout=240.0):
     return live
 
 
+def _memory_report(step, run_step, steps=4):
+    """The ``"memory"`` field (ISSUE 14): live/peak watermark over a few
+    sampled steps (the backend allocator's ``memory_stats`` where it
+    exists, the deterministic tracked-array fallback otherwise), the
+    ``memory_analysis()`` per-device bucket table whose sum
+    reconstructs the measured peak, and whether XLA's compiled-program
+    memory analysis was available on this backend — so every BENCH
+    round pins the memory trajectory next to the time one."""
+    from mxnet_tpu.telemetry import memory
+
+    was = memory.enabled()
+    memory.clear()                       # samples only; pools survive
+    memory.enable()
+    # idempotent re-registration: the report must measure THIS step's
+    # residency even if something earlier in the child wiped the
+    # registry (clear(pools=True))
+    memory.register_provider(step)
+    memory.set_analysis_provider(step.memory_analysis, owner=step)
+    try:
+        for _ in range(steps):
+            run_step()
+        rep = step.memory_analysis()
+        wm = memory.watermarks()
+        out = {
+            'samples': len(wm),
+            'live_bytes_per_device': wm[-1]['device_bytes'] if wm
+            else None,
+            'peak_bytes_per_device': memory.peak_bytes(),
+            'host_rss_bytes': memory.host_rss_bytes(),
+            'source': wm[-1]['source'] if wm else None,
+            'memory_analysis_available': rep is not None,
+            'xla_memory_analysis_available':
+                bool(rep and rep.get('xla')),
+        }
+        if rep:
+            out['buckets_bytes'] = rep['buckets_bytes']
+            out['bucket_sum_over_peak'] = rep['bucket_sum_over_peak']
+            out['measured_fraction'] = rep['measured_fraction']
+            out['zero_stage'] = rep['zero_stage']
+            if rep.get('xla'):
+                out['xla'] = rep['xla']
+        return out
+    finally:
+        memory.clear()
+        (memory.enable if was else memory.disable)()
+
+
 def _attribution_report(step, model, run_step, flops, peak_total,
                         steps=8):
     """Per-step attribution (ISSUE 6): arm span tracing, run a few
@@ -745,6 +792,16 @@ def _child(mode: str) -> None:
     except Exception as e:
         out["zero"] = {"error": repr(e)[:300]}
         _log(f"zero report failed: {e!r}")
+    print(json.dumps(out), flush=True)
+    # memory watermark + bucket attribution (ISSUE 14): the memory half
+    # of the trajectory every BENCH round pins
+    try:
+        out["memory"] = _memory_report(
+            step, lambda: float(step(inputs, [labels, nsp]).asnumpy()))
+        _log(f"memory report: {out['memory']}")
+    except Exception as e:
+        out["memory"] = {"error": repr(e)[:300]}
+        _log(f"memory report failed: {e!r}")
     print(json.dumps(out), flush=True)
     # attribution LAST: with MXTPU_TRACE=1 the whole child traced from
     # import, so the dumped timeline also carries the io report's spans

@@ -373,6 +373,37 @@ register('MXTPU_FLEET_IMBALANCE_FACTOR', float, 1.5,
          'Fleet detector: max/min ratio of per-rank comm bytes per '
          'step above this is flagged as a collective imbalance '
          '(flight note fleet.comm_imbalance).')
+register('MXTPU_MEMORY', _bool, False,
+         'Enable memory watermark sampling (telemetry.memory): per-step '
+         'live/peak device-memory samples — jax device.memory_stats() '
+         'where the backend exposes it, else the deterministic fallback '
+         'summing per-device bytes over the tracked live arrays (params, '
+         'masters, moments, residuals, device-prefetch leases) — plus '
+         'host RSS, into a bounded ring, mxnet_tpu_memory_* gauges, the '
+         'flight-recorder step records and the fleet snapshots. Off: '
+         'the per-step hook is one dict check and allocates nothing. '
+         'The OOM forensics guard is always armed regardless.')
+register('MXTPU_MEMORY_RING', int, 256,
+         'Watermark ring depth: memory samples retained for the OOM '
+         'post-mortem and /healthz (bounded; oldest overwritten).')
+register('MXTPU_MEMORY_EVERY', int, 1,
+         'Memory sampling cadence: record one watermark sample every '
+         'this many steps (1 = every step). Raise it when the fallback '
+         'pool walk over very large parameter sets is measurable.')
+register('MXTPU_MEMORY_LEAK_STEPS', int, 8,
+         'Leak detector: this many CONSECUTIVE samples of monotonic '
+         'live-bytes growth (see MXTPU_MEMORY_LEAK_BYTES) latch one '
+         'memory.leak_suspected flight note; a non-growing sample '
+         'clears the latch.')
+register('MXTPU_MEMORY_LEAK_BYTES', int, 1 << 20,
+         'Leak detector: minimum total live-bytes growth over the '
+         'MXTPU_MEMORY_LEAK_STEPS window before the latch fires (1 MB '
+         'default — step-to-step allocator noise must not page anyone).')
+register('MXTPU_FLEET_MEMORY_IMBALANCE_FACTOR', float, 1.5,
+         'Fleet detector: max/min ratio of per-rank live device memory '
+         '(from the heartbeat-piggybacked memory snapshots) above this '
+         'is flagged as an HBM imbalance on the fattest rank (flight '
+         'note fleet.memory_imbalance).')
 register('MXTPU_SCRUB_SECONDS', float, 300.0,
          'Background checkpoint scrubber cadence: every this many '
          'seconds the scrubber re-hashes one pass over the committed '

@@ -13,7 +13,8 @@ from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..resilience import faults as _faults
-from ..telemetry import trace as _trace, flight as _flight
+from ..telemetry import trace as _trace, flight as _flight, \
+    memory as _memory
 from .parameter import ParameterDict, Parameter
 
 
@@ -138,6 +139,10 @@ class Trainer:
                 if param._data is not None:
                     self._kvstore.init(i, param.data(param.list_ctx()[0]))
         self._kv_initialized = True
+        # memory observability: this trainer's params + optimizer state
+        # become tracked pools for the fallback watermark (weakly
+        # referenced — a dropped trainer never pins its arrays)
+        _memory.register_provider(self)
 
     def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
         if not self._kv_initialized:
@@ -195,8 +200,10 @@ class Trainer:
             self._optimizer.rescale_grad = self._scale / batch_size
             with _trace.span('comm.allreduce'):
                 self._allreduce_grads()
-            with _trace.span('optimizer.update'):
+            with _trace.span('optimizer.update'), \
+                    _memory.oom_guard('step.dispatch'):
                 self._update(ignore_stale_grad)
+        _memory.on_step(self._optimizer.num_update)
         _flight.record_step(self._optimizer.num_update)
         if self._elastic is not None:
             # feed the controller's commit point (and the heartbeat's
@@ -480,8 +487,9 @@ class Trainer:
                 place.append((datas[0], target))
         if place:
             import jax
-            placed = jax.device_put([d._data for d, _ in place],
-                                    [sh for _, sh in place])
+            with _memory.oom_guard('h2d.param_place'):
+                placed = jax.device_put([d._data for d, _ in place],
+                                        [sh for _, sh in place])
             nbytes = 0
             for (d, _), out in zip(place, placed):
                 d._data = out
@@ -526,8 +534,9 @@ class Trainer:
                   zero['state_sh'][n] or zero['w_sh'][n],
                   tuple(datas[0].shape))
         if pending:
-            placed = jax.device_put([s._data for s, _ in pending],
-                                    [sh for _, sh in pending])
+            with _memory.oom_guard('h2d.param_place'):
+                placed = jax.device_put([s._data for s, _ in pending],
+                                        [sh for _, sh in pending])
             nbytes = 0
             for (s, _), d in zip(pending, placed):
                 s._data = d
@@ -580,6 +589,30 @@ class Trainer:
                 continue
             total += device_nbytes(p.data()._data)
         return total
+
+    def memory_pools(self):
+        """The trainer path's live arrays as named residency pools for
+        ``telemetry.memory``'s fallback watermark — the gluon sibling
+        of ``ShardedTrainStep.memory_pools`` (params' primary copies +
+        the updater's per-param optimizer state)."""
+        from ..ndarray.ndarray import NDArray
+        pools = {'params': {}, 'optimizer_state': {}}
+        for p in self._params:
+            if p._data is not None:
+                pools['params'][p.name] = p.data()._data
+
+        def _walk(prefix, s):
+            if isinstance(s, NDArray):
+                pools['optimizer_state'][prefix] = s._data
+            elif isinstance(s, (list, tuple)):
+                for j, x in enumerate(s):
+                    _walk(f'{prefix}/{j}', x)
+
+        if self._updater is not None:
+            names = {i: p.name for i, p in enumerate(self._params)}
+            for i, st in self._updater.states.items():
+                _walk(f'state/{names.get(i, i)}', st)
+        return pools
 
     def _fused_apply(self, items):
         """Run every parameter update as ONE compiled XLA program.

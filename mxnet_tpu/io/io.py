@@ -17,7 +17,7 @@ import numpy as onp
 from ..base import DataError, MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray, array
 from ..resilience import faults as _faults
-from ..telemetry import trace as _trace
+from ..telemetry import trace as _trace, memory as _memory
 
 
 # ---------------------------------------------------------------------------
@@ -73,7 +73,8 @@ def _device_put_batch(batch, ctx=None):
             return NDArray(data)
         return x
 
-    with _trace.span('h2d.device_put'):
+    with _trace.span('h2d.device_put'), \
+            _memory.oom_guard('io.device_put'):
         if batch.data is not None:
             batch.data = [put(d) for d in batch.data]
         if batch.label is not None:
@@ -445,6 +446,21 @@ class DevicePrefetchIter(DataIter):
         self._buf = collections.deque()   # (batch, dispatch timestamp)
         self._ended = False
         self._peek = None
+        # memory observability: the in-flight device batches are live
+        # HBM the step's own pools never see — tracked as 'io_leases'
+        _memory.register_provider(self)
+
+    def memory_pools(self):
+        """In-flight device-prefetched batches as the ``io_leases``
+        residency pool (telemetry.memory fallback watermark)."""
+        leases = {}
+        for i, (batch, _t0) in enumerate(self._buf):
+            for kind, arrs in (('data', batch.data or ()),
+                               ('label', batch.label or ())):
+                for j, a in enumerate(arrs):
+                    if isinstance(a, NDArray):
+                        leases[f'inflight{i}/{kind}{j}'] = a._data
+        return {'io_leases': leases}
 
     @property
     def provide_data(self):

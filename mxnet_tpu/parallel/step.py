@@ -63,7 +63,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..base import MXNetError, state as _flags, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
-from ..telemetry import trace as _trace, flight as _flight
+from ..telemetry import trace as _trace, flight as _flight, \
+    memory as _memory
 from .. import random as _random
 from . import compression as _compression
 from .collectives import group_params_by_layer, ordered_barrier
@@ -942,7 +943,8 @@ class ShardedTrainStep:
                     for n, p in trainable}
             self._build(in_datas, lab_datas)
             # place params on the mesh with their shardings
-            with _trace.span('h2d.param_place'):
+            with _trace.span('h2d.param_place'), \
+                    _memory.oom_guard('h2d.param_place'):
                 for n, p in self._trainable:
                     p._data[0]._data = _put_replicated(
                         p.data()._data, self._t_shardings[n])
@@ -970,6 +972,14 @@ class ShardedTrainStep:
             if self._pending_states is not None:
                 doc, self._pending_states = self._pending_states, None
                 self._apply_states(doc)
+            # memory observability: this step's live arrays (params /
+            # masters+moments / residuals) become tracked pools for the
+            # fallback watermark, and its memory_analysis() feeds the
+            # OOM post-mortem's bucket table. Weakly referenced — a
+            # rebuilt/dropped step never double-counts or pins arrays.
+            _memory.register_provider(self)
+            _memory.set_analysis_provider(self.memory_analysis,
+                                          owner=self)
             if _telem['on']:
                 from .. import telemetry as _telemetry
                 _telemetry.set_gauge(
@@ -992,7 +1002,8 @@ class ShardedTrainStep:
         f_params = {n: p.data()._data for n, p in self._frozen}
         key = _random.next_key()
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
-        with _trace.span('h2d.batch_put'):
+        with _trace.span('h2d.batch_put'), \
+                _memory.oom_guard('h2d.batch_put'):
             in_datas = tuple(_put_batch(x, self._batch_sh)
                              for x in in_datas)
             lab_datas = tuple(_put_batch(x, self._batch_sh)
@@ -1005,7 +1016,8 @@ class ShardedTrainStep:
                 (t_params, f_params, self._master, self._opt_state,
                  self._residual, in_datas, lab_datas, key, lr_val,
                  fault_scale))
-        with _trace.span('step.compiled'):
+        with _trace.span('step.compiled'), \
+                _memory.oom_guard('step.dispatch'):
             out = self._compiled(
                 t_params, f_params, self._master, self._opt_state,
                 self._residual, in_datas, lab_datas, key, lr_val,
@@ -1069,6 +1081,7 @@ class ShardedTrainStep:
                         codec=self._comp_plan['codec'],
                         axis=self._comp_plan['axis'])
         loss_nd = NDArray(_local_value(loss))
+        _memory.on_step(self._step_count)
         _flight.record_step(self._step_count, loss=loss_nd)
         return loss_nd
 
@@ -1123,12 +1136,13 @@ class ShardedTrainStep:
         step cannot consume cpu-committed arrays."""
         if self._compiled is None:
             return
-        for n, p in self._trainable:
-            p._data[0]._data = _put_replicated(
-                onp.asarray(p.data()._data), self._t_shardings[n])
-        for n, p in self._frozen:
-            p._data[0]._data = _put_replicated(
-                onp.asarray(p.data()._data), self._f_shardings[n])
+        with _memory.oom_guard('checkpoint.restore'):
+            for n, p in self._trainable:
+                p._data[0]._data = _put_replicated(
+                    onp.asarray(p.data()._data), self._t_shardings[n])
+            for n, p in self._frozen:
+                p._data[0]._data = _put_replicated(
+                    onp.asarray(p.data()._data), self._f_shardings[n])
 
     # ------------------------------------------------------------------
     # optimizer-state introspection + layout-independent checkpointing
@@ -1149,6 +1163,132 @@ class ShardedTrainStep:
         except Exception:
             return None
         return _attribution.xla_cost(compiled)
+
+    def memory_pools(self):
+        """This step's live persistent arrays as named residency pools
+        for ``telemetry.memory``'s fallback watermark:
+        ``{'params', 'optimizer_state', 'residuals'} ->
+        {array_name: jax array}``. Per-device byte accounting happens in
+        the memory module (``entry_nbytes`` — the local shard for
+        sharded arrays, so ZeRO residency is *measured*, not derived)."""
+        pools = {'params': {}, 'optimizer_state': {}, 'residuals': {}}
+        for n, p in (self._trainable or []) + (self._frozen or []):
+            if p._data is not None:
+                pools['params'][n] = p.data()._data
+        for n, m in (self._master or {}).items():
+            pools['optimizer_state'][f'master/{n}'] = m
+        for n, st in (self._opt_state or {}).items():
+            for i, s in enumerate(st):
+                pools['optimizer_state'][f'moment{i}/{n}'] = s
+        for n, r in (self._residual or {}).items():
+            pools['residuals'][n] = r
+        return pools
+
+    def memory_analysis(self, peak_bytes=None):
+        """Per-device memory attribution — the ``cost_analysis()``
+        sibling (ISSUE 14). Joins the measured residency pools (local
+        shard bytes of every live param/master/moment/residual), the
+        ZeRO-3 per-layer layout + gather-plan accounting, and XLA's own
+        compiled-program memory analysis into a bucket table
+
+            params / optimizer_state / residuals / io_leases /
+            activations_temp
+
+        whose sum reconstructs the measured peak by construction:
+        ``activations_temp`` is the explicit residual (peak minus the
+        tracked persistent buckets), exactly how the wall-time report
+        defines ``compute`` — with ``measured_fraction`` stating how
+        much of the peak the tracked pools explain. ``peak_bytes``
+        defaults to the backend allocator's peak where exposed, else
+        the fallback watermark high-water mark (so on CPU the table is
+        still honest: the residual is then ~0 and the buckets ARE the
+        measurement). None before the first step."""
+        if self._compiled is None:
+            return None
+        pools = self.memory_pools()
+        buckets = {
+            'params': _memory.pool_nbytes(pools.get('params')),
+            'optimizer_state':
+                _memory.pool_nbytes(pools.get('optimizer_state')),
+            'residuals': _memory.pool_nbytes(pools.get('residuals')),
+            'io_leases': _memory.pool_bytes_by_name('io_leases'),
+        }
+        persistent = sum(buckets.values())
+        source = 'fallback'
+        if peak_bytes is None:
+            stats = _memory.device_memory_stats()
+            if stats is not None and stats.get('peak_bytes_in_use'):
+                peak_bytes = int(stats['peak_bytes_in_use'])
+                source = 'memory_stats'
+            else:
+                peak_bytes = max(_memory.peak_bytes(), persistent)
+        peak_bytes = max(int(peak_bytes), persistent)
+        buckets['activations_temp'] = peak_bytes - persistent
+        # per-layer persistent residency: the same layer grouping the
+        # ZeRO-3 gather pipeline schedules by, summed over the layer's
+        # params + masters + moments + residuals (per-device bytes) —
+        # with the analytic gather wire plan alongside so the
+        # remat-policy sweep can weigh persistent vs transient per layer
+        per_layer = {}
+        by_param = {}
+        for pool in pools.values():
+            for aname, arr in pool.items():
+                pname = aname.split('/', 1)[-1]
+                by_param[pname] = by_param.get(pname, 0) \
+                    + _memory.entry_nbytes(arr)
+        for gname, names in group_params_by_layer(self._t_names or []):
+            per_layer[gname] = sum(by_param.get(n, 0) for n in names)
+        self.opt_state_bytes_per_device()       # refreshes pad bytes
+        out = {
+            'peak_bytes_per_device': peak_bytes,
+            'source': source,
+            'buckets_bytes': buckets,
+            'bucket_fractions': {
+                k: round(v / peak_bytes, 4) if peak_bytes else 0.0
+                for k, v in buckets.items()},
+            'bucket_sum_over_peak':
+                round(sum(buckets.values()) / peak_bytes, 4)
+                if peak_bytes else 0.0,
+            'measured_fraction':
+                round(min(persistent, peak_bytes) / peak_bytes, 4)
+                if peak_bytes else 0.0,
+            'zero_stage': self.zero_stage,
+            'dp': self._dp_size,
+            'compression': self.compression['type']
+            if self.compression else None,
+            'pad_bytes': getattr(self, 'opt_state_pad_bytes', 0),
+            'per_layer_bytes': per_layer,
+            'host_rss_bytes': _memory.host_rss_bytes(),
+        }
+        if getattr(self, '_gather_plan', None):
+            out['gather_bytes_per_layer'] = {
+                str(layer): int(nbytes)
+                for layer, nbytes, _c in self._gather_plan}
+        xla = self._xla_memory_analysis()
+        if xla:
+            out['xla'] = xla
+        return out
+
+    def _xla_memory_analysis(self):
+        """XLA's CompiledMemoryStats for one step program (argument /
+        output / temp / generated-code / alias bytes), or None where
+        the backend exposes none — reported alongside the measured
+        buckets, never substituted for them."""
+        if self._compiled is None or self._cost_args is None:
+            return None
+        try:
+            compiled = self._compiled.lower(*self._cost_args).compile()
+            ma = compiled.memory_analysis()
+        except Exception:
+            return None
+        out = {}
+        for k in ('argument_size_in_bytes', 'output_size_in_bytes',
+                  'temp_size_in_bytes', 'alias_size_in_bytes',
+                  'generated_code_size_in_bytes'):
+            v = getattr(ma, k, None)
+            if v is not None:
+                out[k] = int(v)
+        return out or None
 
     def _master_host(self, n, arr):
         """Host-side fp32 master for param ``n`` in its PERSISTENT
@@ -1313,6 +1453,13 @@ class ShardedTrainStep:
         self._apply_states(doc)
 
     def _apply_states(self, doc):
+        # restore re-place is a burst of device allocations over a
+        # device already holding the pre-restore state — an OOM here
+        # must leave the same forensics as one mid-step
+        with _memory.oom_guard('checkpoint.restore'):
+            self._apply_states_guarded(doc)
+
+    def _apply_states_guarded(self, doc):
         for n, st in doc['opt_state'].items():
             if n not in self._opt_state:
                 raise MXNetError(f"set_states_bytes: unknown parameter "

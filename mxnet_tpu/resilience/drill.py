@@ -37,7 +37,7 @@ import subprocess
 import sys
 import time as _time
 
-__all__ = ['run_drill', 'run_fleet_drill']
+__all__ = ['run_drill', 'run_fleet_drill', 'run_oom_drill']
 
 
 def _free_port():
@@ -481,6 +481,101 @@ def run_fleet_drill(workdir, steps=8, heartbeat=0.2, step_sleep=0.1,
         'healthz_status': {r: scraped[r]['healthz']['status']
                            for r in scraped},
     }
+
+
+def run_oom_drill(workdir, steps_before=3):
+    """OOM forensics drill (ISSUE 14) — no real 16 GB chip required.
+
+    Trains the drill model a few steps with memory watermarking armed,
+    then arms the deterministic ``alloc.oom`` fault so the NEXT pass
+    through a guarded dispatch site raises a synthetic
+    RESOURCE_EXHAUSTED through ``telemetry.memory.oom_guard``. Asserts
+    the guard wrote exactly the post-mortem a real allocator
+    exhaustion would:
+
+    - the dump validates against the ``mxtpu_oom_v1`` schema,
+    - it names the largest live tracked array (with shape/dtype/
+      sharding) and carries the watermark ring + bucket analysis,
+    - the ``memory.oom`` flight note landed.
+
+    Returns the summary dict ``dryrun_multichip`` prints each
+    MULTICHIP round. In-process (the fault is deterministic and the
+    exception is caught here) — state is restored on exit."""
+    import json
+
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.telemetry import flight, memory, trace
+    from . import faults
+
+    prev_dir = _config.get('MXTPU_FLIGHT_DIR')
+    was_mem, was_trace = memory.enabled(), trace.enabled()
+    os.environ['MXTPU_FLIGHT_DIR'] = str(workdir)
+    memory.clear()
+    memory.enable()
+    trace.enable()             # the memory.oom flight note needs the ring
+    try:
+        from mxnet_tpu.parallel.mesh import default_mesh
+        _net, step, mgr = _build(str(workdir), 0, default_mesh())
+        for i in range(steps_before):
+            _run_step(step, i)
+        analysis = step.memory_analysis()
+        assert analysis is not None, "no memory_analysis after steps"
+        # falsifiable: the buckets must measure THIS step's residency
+        # (sum==peak alone holds by construction)
+        assert analysis['buckets_bytes']['params'] \
+            == step.param_bytes_per_device(), analysis
+        assert analysis['buckets_bytes']['optimizer_state'] \
+            == step.opt_state_bytes_per_device(), analysis
+        faults.arm('alloc.oom', 'raise', window=1)
+        err = None
+        try:
+            _run_step(step, steps_before)
+        except faults.InjectedFault as e:
+            err = e
+        assert err is not None and err.site == 'alloc.oom', \
+            "injected alloc.oom did not surface"
+        path = memory.default_oom_path()
+        assert os.path.exists(path), f"no forensics dump at {path}"
+        with open(path) as f:
+            doc = json.load(f)
+        problems = memory.validate_oom_dump(doc)
+        assert not problems, problems
+        assert doc['top_arrays'], "dump names no live arrays"
+        top = doc['top_arrays'][0]
+        live = {}
+        for pool in memory.pools().values():
+            live.update(pool)
+        biggest = max(memory.entry_nbytes(a) for a in live.values())
+        peers = {n for n, a in live.items()
+                 if memory.entry_nbytes(a) == biggest}
+        # the dump's prime suspect IS the largest live allocation
+        # (several arrays may tie at the same byte size)
+        assert top['nbytes'] == biggest and top['name'] in peers, \
+            (top, biggest, sorted(peers))
+        notes = [e['kind'] for e in flight.get().events()
+                 if e['kind'] == 'memory.oom']
+        mgr.close()
+        return {
+            'ok': True,
+            'path': path,
+            'site': doc['site'],
+            'top_array': {k: top[k] for k in
+                          ('pool', 'name', 'nbytes') if k in top},
+            'device_bytes': doc['device_bytes'],
+            'peak_bytes': doc['peak_bytes'],
+            'watermark_samples': len(doc['watermarks']),
+            'hints': [h['action'] for h in doc['hints']],
+            'flight_noted': bool(notes),
+        }
+    finally:
+        faults.disarm('alloc.oom')
+        memory.clear()
+        (memory.enable if was_mem else memory.disable)()
+        (trace.enable if was_trace else trace.disable)()
+        if prev_dir:
+            os.environ['MXTPU_FLIGHT_DIR'] = prev_dir
+        else:
+            os.environ.pop('MXTPU_FLIGHT_DIR', None)
 
 
 def _reference(args):

@@ -106,6 +106,13 @@ _SITES = {
     'dist.barrier': ('membership barrier entry (dist.barrier / kvstore '
                      'barrier on dist stores) — the rendezvous every '
                      'mesh re-form crosses', ('raise', 'hang')),
+    'alloc.oom': ('device allocator exhaustion: a raise here surfaces '
+                  'as a synthetic RESOURCE_EXHAUSTED through the '
+                  'telemetry.memory.oom_guard wrapping step dispatch, '
+                  'h2d batch/param placement and checkpoint-restore '
+                  're-place — the OOM forensics dump drills without a '
+                  'real 16GB chip (resilience.drill.run_oom_drill)',
+                  ('raise',)),
 }
 
 _lock = threading.RLock()

@@ -17,6 +17,13 @@ cooperating layers:
 - ``telemetry.attribution`` — joins measured spans with XLA
   cost_analysis into the per-step input/h2d/compute/collective/
   host-sync breakdown bench.py and tools/tune_bert_step.py report.
+- ``telemetry.memory`` — the memory half of attribution
+  (``MXTPU_MEMORY``): HBM/host watermark sampling (device
+  ``memory_stats`` or the deterministic tracked-array fallback) into a
+  bounded ring + ``mxnet_tpu_memory_*`` gauges, a step-over-step leak
+  detector, and the always-armed OOM forensics guard that dumps one
+  atomic post-mortem (watermarks, bucket table, top live arrays,
+  what-would-fit hints) when RESOURCE_EXHAUSTED hits a dispatch site.
 - ``telemetry.fleet`` — cross-rank aggregation: per-step snapshots
   piggybacked on membership heartbeats, merged into a coordinator
   fleet view with per-rank skew, clock-offset estimation for trace
@@ -31,10 +38,11 @@ from .metrics import (  # noqa: F401  (non-__all__ names used by tests/tools)
 )
 from .metrics import __all__ as _metrics_all
 from . import trace          # noqa: F401
+from . import memory         # noqa: F401
 from . import flight         # noqa: F401
 from . import attribution    # noqa: F401
 from . import fleet          # noqa: F401
 from . import server         # noqa: F401
 
-__all__ = list(_metrics_all) + ['trace', 'flight', 'attribution',
-                                'fleet', 'server']
+__all__ = list(_metrics_all) + ['trace', 'memory', 'flight',
+                                'attribution', 'fleet', 'server']

@@ -34,7 +34,15 @@ xprof trace alongside this report so the residual's in-program split
 from __future__ import annotations
 
 __all__ = ['BUCKET_PREFIXES', 'bucket_of', 'subsystems', 'report',
-           'format_table', 'xla_cost']
+           'format_table', 'format_memory_table', 'xla_cost',
+           'MEMORY_BUCKETS']
+
+# memory_analysis() bucket order (ShardedTrainStep.memory_analysis /
+# telemetry.memory): persistent residency buckets, then the residual
+# activations-temp bucket that makes the sum reconstruct the measured
+# peak — the memory analog of the wall-time table above
+MEMORY_BUCKETS = ('params', 'optimizer_state', 'residuals', 'io_leases',
+                  'activations_temp')
 
 # span-name prefix -> bucket; everything else is residual 'compute'
 BUCKET_PREFIXES = (
@@ -176,6 +184,58 @@ def report(steps, flops_per_step=None, bytes_per_step=None,
     if losses:
         out['loss_last'] = losses[-1]
     return out
+
+
+def _mb(nbytes):
+    return nbytes / 1e6
+
+
+def format_memory_table(rep):
+    """Monospace table of a ``ShardedTrainStep.memory_analysis()`` dict
+    (tools / PERF_NOTES) — the memory sibling of ``format_table``:
+    per-device residency buckets whose sum reconstructs the measured
+    peak (activations-temp is the explicit residual), the per-layer
+    breakdown, and XLA's own compiled-program memory analysis when the
+    backend exposes it."""
+    if rep is None:
+        return 'memory: no analysis (run at least one step first)'
+    if 'error' in rep:
+        return f"memory: {rep['error']}"
+    lines = [
+        f"peak {_mb(rep['peak_bytes_per_device']):.3f} MB/device "
+        f"({rep['source']}; measured "
+        f"{100 * rep['measured_fraction']:.1f}%, residual = "
+        f"activations-temp) zero={rep['zero_stage']} dp={rep['dp']}"
+        + (f" compression={rep['compression']}" if rep.get('compression')
+           else ''),
+        f"{'bucket':<18s}{'MB/device':>12s}{'fraction':>10s}",
+    ]
+    for b in MEMORY_BUCKETS:
+        lines.append(f"{b:<18s}{_mb(rep['buckets_bytes'][b]):>12.3f}"
+                     f"{100 * rep['bucket_fractions'][b]:>9.1f}%")
+    if rep.get('pad_bytes'):
+        lines.append(f"(zero3 flat pad slack "
+                     f"{_mb(rep['pad_bytes']):.3f} MB/device)")
+    xla = rep.get('xla')
+    if xla:
+        lines.append(
+            "xla memory_analysis: "
+            + ' '.join(f"{k.replace('_size_in_bytes', '')}="
+                       f"{_mb(v):.3f}MB" for k, v in sorted(xla.items())))
+    per_layer = rep.get('per_layer_bytes')
+    if per_layer:
+        lines.append('')
+        lines.append(f"{'layer':<28s}{'persistent MB':>14s}"
+                     f"{'gather MB/step':>15s}")
+        gathers = rep.get('gather_bytes_per_layer') or {}
+        rows = sorted(per_layer.items(), key=lambda kv: -kv[1])
+        for layer, nb in rows:
+            g = gathers.get(layer, 0)
+            lines.append(f"{str(layer)[:27]:<28s}{_mb(nb):>14.3f}"
+                         f"{_mb(g):>15.3f}")
+    if rep.get('host_rss_bytes'):
+        lines.append(f"host RSS {_mb(rep['host_rss_bytes']):.1f} MB")
+    return '\n'.join(lines)
 
 
 def format_table(rep):

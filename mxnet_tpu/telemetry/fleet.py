@@ -50,6 +50,7 @@ import time as _time
 
 from ..base import telem_flags as _telem
 from . import flight as _flight
+from . import memory as _memory
 from . import metrics as _metrics
 from . import trace as _trace
 from .attribution import bucket_of
@@ -111,6 +112,11 @@ def local_snapshot():
     comm = comm_bytes_by_axis()
     if comm:
         snap['comm_bytes'] = comm
+    # memory watermark (MXTPU_MEMORY): a few tens of bytes so the
+    # coordinator can flag per-rank HBM imbalance before an OOM
+    mem = _memory.snapshot_fields()
+    if mem is not None:
+        snap['mem'] = mem
     counters = _counter_sums()
     if counters:
         snap['counters'] = counters
@@ -172,7 +178,7 @@ class _RankState:
     __slots__ = ('step', 'wall_ms', 'ewma_ms', 'loss', 'losses',
                  'comm_total', 'comm_rate', 'counters', 'offset',
                  'last_mono', 'last_time', 'snapshots', 'spans_ms',
-                 'flags')
+                 'flags', 'mem_bytes', 'mem_peak')
 
     def __init__(self):
         self.step = None
@@ -189,6 +195,8 @@ class _RankState:
         self.snapshots = 0
         self.spans_ms = {}
         self.flags = set()          # currently-raised anomaly kinds
+        self.mem_bytes = None       # live device bytes (memory snapshot)
+        self.mem_peak = None
 
 
 class FleetMonitor:
@@ -203,7 +211,7 @@ class FleetMonitor:
     def __init__(self, window=None, regression_factor=None,
                  straggler_factor=None, stale_seconds=None,
                  loss_spike_sigma=None, imbalance_factor=None,
-                 heartbeat_seconds=None):
+                 heartbeat_seconds=None, memory_imbalance_factor=None):
         from .. import config as _config
         self.window = int(window if window is not None
                           else _config.get('MXTPU_FLEET_WINDOW'))
@@ -229,6 +237,9 @@ class FleetMonitor:
         self.imbalance_factor = float(
             imbalance_factor if imbalance_factor is not None
             else _config.get('MXTPU_FLEET_IMBALANCE_FACTOR'))
+        self.memory_imbalance_factor = float(
+            memory_imbalance_factor if memory_imbalance_factor is not None
+            else _config.get('MXTPU_FLEET_MEMORY_IMBALANCE_FACTOR'))
         # RLock by the same signal-safety rationale as the flight
         # recorder: straggler()/view() are reachable from crash-time
         # reporting paths that may interrupt an ingest on this thread
@@ -272,6 +283,12 @@ class FleetMonitor:
             if snap.get('counters'):
                 st.counters = dict(snap['counters'])
             fired = []
+            mem = snap.get('mem')
+            if mem and mem.get('live') is not None:
+                st.mem_bytes = int(mem['live'])
+                if mem.get('peak') is not None:
+                    st.mem_peak = int(mem['peak'])
+                fired += self._check_memory(now)
             if stepped:
                 dstep = snap['step'] - st.step if st.step is not None \
                     else None
@@ -400,6 +417,12 @@ class FleetMonitor:
                  for r, s in self.ranks.items() if s.comm_rate}
         live = {r: v for r, v in rates.items() if v > 0}
         if len(live) < 2:
+            # fewer than 2 reporters is not "balanced" — it is
+            # "uncomparable": clear any latched flag so a survivor
+            # whose peer departed (or stopped reporting) is not stuck
+            # flagged forever with its next offense latch-swallowed
+            for st in self.ranks.values():
+                st.flags.discard('fleet.comm_imbalance')
             return []
         hi_rank = max(live, key=live.get)
         ratio = live[hi_rank] / min(live.values())
@@ -418,6 +441,36 @@ class FleetMonitor:
                             {r2: int(v) for r2, v in live.items()}}))
             else:
                 st.flags.discard('fleet.comm_imbalance')
+        return fired
+
+    def _check_memory(self, _now):
+        """HBM imbalance: per-rank live device bytes whose max/min
+        ratio exceeds the factor flag the FATTEST rank — the one a
+        shared-config fleet expects to OOM first (a rank quietly
+        holding 1.5x its peers' memory is a layout bug or a leak, not
+        load balancing). Same current-worst-offender flag discipline
+        as the comm detector."""
+        live = {r: s.mem_bytes for r, s in self.ranks.items()
+                if s.mem_bytes}
+        if len(live) < 2:
+            # same unlatch-on-uncomparable rule as the comm detector:
+            # a lone reporter must not keep a stale imbalance flag
+            for st in self.ranks.values():
+                st.flags.discard('fleet.memory_imbalance')
+            return []
+        hi_rank = max(live, key=live.get)
+        ratio = live[hi_rank] / min(live.values())
+        imbalanced = ratio > self.memory_imbalance_factor
+        fired = []
+        for r, st in self.ranks.items():
+            if r == hi_rank and imbalanced:
+                if 'fleet.memory_imbalance' not in st.flags:
+                    st.flags.add('fleet.memory_imbalance')
+                    fired.append(('fleet.memory_imbalance', {
+                        'rank': hi_rank, 'ratio': round(ratio, 2),
+                        'bytes': {r2: int(v) for r2, v in live.items()}}))
+            else:
+                st.flags.discard('fleet.memory_imbalance')
         return fired
 
     # -- exports -----------------------------------------------------------
@@ -469,6 +522,11 @@ class FleetMonitor:
                 _metrics.set_gauge(
                     'mxnet_tpu_fleet_snapshot_age_seconds',
                     round(now - mono, 3), rank=rank)
+            if st.mem_bytes is not None:
+                # mirrors the rank's own memory watermark (the same
+                # exactly-agreeing-scrapes contract as the comm gauge)
+                _metrics.set_gauge('mxnet_tpu_fleet_memory_bytes',
+                                   st.mem_bytes, rank=rank)
             for axis, total in comm_total.items():
                 # a gauge MIRRORING the rank's own cumulative per-hop
                 # counter (not a local re-count): a fleet scrape of the
@@ -505,6 +563,8 @@ class FleetMonitor:
                     'comm_bytes_per_step':
                         {a: int(v) for a, v in st.comm_rate.items()},
                     'comm_bytes_total': dict(st.comm_total),
+                    'memory_bytes': st.mem_bytes,
+                    'memory_peak_bytes': st.mem_peak,
                     'counters': dict(st.counters),
                     'spans_ms': dict(st.spans_ms),
                     'snapshots': st.snapshots,
@@ -616,7 +676,7 @@ class FleetMonitor:
         'mxnet_tpu_fleet_step_skew_ms', 'mxnet_tpu_fleet_step_seconds',
         'mxnet_tpu_fleet_loss', 'mxnet_tpu_fleet_clock_offset_seconds',
         'mxnet_tpu_fleet_snapshot_age_seconds',
-        'mxnet_tpu_fleet_comm_bytes',
+        'mxnet_tpu_fleet_comm_bytes', 'mxnet_tpu_fleet_memory_bytes',
     )
 
     def remove_ranks(self, ranks):

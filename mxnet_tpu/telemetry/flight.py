@@ -40,6 +40,7 @@ import threading
 import time as _time
 
 from ..base import telem_flags as _telem
+from . import memory as _memory
 from . import trace as _trace
 
 __all__ = ['FlightRecorder', 'get', 'record_step', 'note',
@@ -83,6 +84,12 @@ class FlightRecorder:
         self._last_t = now
         if guard_ok is not None:
             rec['guard_ok'] = bool(guard_ok)
+        # memory watermark fields (MXTPU_MEMORY): the newest sample's
+        # prebuilt dict — disarmed this is one dict check returning the
+        # shared None, same no-alloc discipline as the trace gate
+        mem = _memory.step_fields()
+        if mem is not None:
+            rec['mem'] = mem
         if extra:
             rec.update(extra)
         with self._lock:
@@ -221,6 +228,11 @@ class FlightRecorder:
         if _telem['on'] and not signal_safe:
             from . import metrics as _metrics
             _metrics.inc('mxnet_tpu_trace_flight_dumps_total')
+        d = os.path.dirname(path)
+        if d:
+            # a not-yet-created MXTPU_FLIGHT_DIR must not silently lose
+            # the post-mortem (same fix as memory.dump_oom)
+            os.makedirs(d, exist_ok=True)
         from ..serialization import atomic_write_file
         atomic_write_file(path, json.dumps(doc, default=str).encode())
         return path

@@ -243,6 +243,15 @@ class TelemetryServer:
         if sps is not None:
             doc['samples_per_second'] = sps
         try:
+            # live/peak device memory + host RSS, computed on demand
+            # (cold path; tracked-array fallback where the backend
+            # exposes no allocator stats) — a fleet operator should see
+            # the pressure BEFORE the OOM, not in its post-mortem
+            from . import memory as _memory
+            doc['memory'] = _memory.health_fields()
+        except Exception:
+            doc['memory'] = None
+        try:
             from ..checkpoint import last_committed_step
             doc['last_committed_step'] = last_committed_step()
         except Exception:

@@ -1,0 +1,643 @@
+"""Memory observability (ISSUE 14): HBM/host watermark tracking,
+per-layer memory attribution, and OOM forensics.
+
+Covers the tentpole contracts:
+
+- watermark ring bounded; the disarmed per-step hook allocates nothing
+  (tracemalloc-asserted, the same bar as trace/fleet);
+- fallback-vs-memory_stats parity: on CPU the deterministic fallback
+  (per-device bytes over the tracked live arrays) IS the watermark and
+  matches the analytic ZeRO accounting byte-exactly; a backend exposing
+  allocator stats takes them verbatim;
+- ``ShardedTrainStep.memory_analysis()`` bucket sum reconstructs the
+  measured fallback peak on the tiny-BERT CPU step (acceptance
+  criterion), with the per-layer table and XLA's memory analysis joined
+  in;
+- leak-detector latch/clear semantics;
+- the ``alloc.oom`` drill produces a schema-valid forensics dump naming
+  the largest live array;
+- the fleet HBM-imbalance detector flags an injected fat rank;
+- bench.py's ``"memory"`` field contract (alongside
+  test_bench_contract.py's JSON-line contracts).
+"""
+import importlib.util
+import json
+import os
+import tracemalloc
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.telemetry import attribution, fleet, flight, memory, \
+    server, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    memory.disable()
+    memory.clear(pools=True)
+    faults.disarm()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    memory.disable()
+    memory.clear(pools=True)
+    faults.disarm()
+
+
+def _dense_step(mesh=None, zero=None):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu', in_units=16))
+    net.add(nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss_fn, 'adam',
+                            {'learning_rate': 0.01},
+                            mesh=mesh or make_mesh((8,), ('dp',)),
+                            zero=zero)
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.randn(64, 16).astype(onp.float32))
+    y = nd.array(rng.randint(0, 8, 64).astype(onp.float32))
+    return net, step, (x, y)
+
+
+def _tiny_bert_step(zero=1):
+    from mxnet_tpu.models import BertForPretraining
+    from mxnet_tpu.models.bert import bert_pretrain_loss
+    cfg = dict(vocab_size=256, hidden=32, layers=2, heads=2,
+               intermediate=64, max_len=64, type_vocab=2, dropout=0.0)
+    mx.random.seed(0)
+    model = BertForPretraining(cfg)
+    model.initialize(mx.init.Normal(0.02))
+    mesh = make_mesh((8,), ('dp',))
+    step = ShardedTrainStep(model, bert_pretrain_loss, 'adamw',
+                            {'learning_rate': 1e-4}, mesh=mesh,
+                            zero=zero)
+    rng = onp.random.RandomState(0)
+    batch, seq = 8, 16
+    tokens = nd.array(rng.randint(0, 256, (batch, seq)).astype(onp.int32))
+    types = nd.array(onp.zeros((batch, seq), onp.int32))
+    labels = onp.full((batch, seq), -1, onp.int32)
+    labels[:, :4] = rng.randint(0, 256, (batch, 4))
+    inputs = ([tokens, types],
+              [nd.array(labels),
+               nd.array(rng.randint(0, 2, batch).astype(onp.int32))])
+    return model, step, inputs
+
+
+# ---------------------------------------------------------------------------
+# watermark ring + sampling
+# ---------------------------------------------------------------------------
+
+def test_watermark_ring_is_bounded():
+    memory.clear(ring=8)
+    memory.enable()
+    for i in range(40):
+        memory.sample(step=i)
+    wm = memory.watermarks()
+    assert len(wm) == 8
+    assert [r['step'] for r in wm] == list(range(32, 40))
+    # peak survives the overwritten samples
+    assert memory.peak_bytes() == max(r['device_bytes'] for r in wm)
+
+
+def test_disarmed_step_hook_allocates_nothing():
+    """The per-step hooks the dispatch paths call (on_step +
+    step_fields) must cost one dict check and ZERO allocation while
+    disarmed — the same bar trace.py and fleet hold."""
+    memory.disable()
+
+    def hot_loop(n):
+        for i in range(n):
+            memory.on_step(i)
+            memory.step_fields()
+
+    hot_loop(64)                         # warm up caches
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    hot_loop(2000)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(d.size_diff for d in after.compare_to(before, 'filename')
+                if d.size_diff > 0)
+    assert grown < 4096, f"disarmed memory path leaked {grown} bytes"
+    assert memory.watermarks() == []
+
+
+def test_sampling_cadence_every_n_steps():
+    memory.clear(every=3)
+    memory.enable()
+    for i in range(9):
+        memory.on_step(i)
+    assert len(memory.watermarks()) == 3
+
+
+def test_flight_record_gains_watermark_fields():
+    trace.enable()
+    memory.enable()
+    memory.sample(step=1)
+    flight.get().clear()
+    flight.record_step(1)
+    rec = flight.get().last_step_record()
+    assert rec['mem']['device_bytes'] >= 0
+    assert rec['mem']['source'] in ('fallback', 'memory_stats')
+    assert set(rec['mem']) == {'device_bytes', 'peak_bytes',
+                               'host_rss_bytes', 'source'}
+    # disarmed: no mem field, no cost
+    memory.disable()
+    flight.record_step(2)
+    assert 'mem' not in flight.get().last_step_record()
+
+
+# ---------------------------------------------------------------------------
+# fallback vs memory_stats parity
+# ---------------------------------------------------------------------------
+
+def test_fallback_matches_analytic_accounting_on_cpu():
+    """CPU exposes no allocator stats, so the watermark IS the
+    deterministic fallback — and it must equal the analytic
+    param/opt-state accounting byte-exactly (the same device_nbytes
+    unit): the PR 7 shrink numbers become measured."""
+    memory.enable()
+    _net, step, (x, y) = _dense_step()
+    for _ in range(2):
+        step(x, y)
+    wm = memory.watermarks()[-1]
+    assert wm['source'] == 'fallback'
+    analytic = step.param_bytes_per_device() \
+        + step.opt_state_bytes_per_device()
+    assert wm['device_bytes'] == analytic
+    total, by_pool = memory.live_bytes()
+    assert total == analytic
+    assert by_pool['params'] == step.param_bytes_per_device()
+    assert by_pool['optimizer_state'] == step.opt_state_bytes_per_device()
+
+
+def test_memory_stats_source_wins_when_backend_exposes_it(monkeypatch):
+    """A backend with allocator stats (TPU/GPU) is taken verbatim; the
+    fallback still rides in the record for cross-checking."""
+    memory.enable()
+    _net, step, (x, y) = _dense_step()
+    step(x, y)
+    fake = {'bytes_in_use': 123456789, 'peak_bytes_in_use': 223456789,
+            'bytes_limit': 16 * 2 ** 30}
+    monkeypatch.setattr(memory, 'device_memory_stats',
+                        lambda device=None: dict(fake))
+    rec = memory.sample(step=99)
+    assert rec['source'] == 'memory_stats'
+    assert rec['device_bytes'] == fake['bytes_in_use']
+    assert rec['fallback_bytes'] == step.param_bytes_per_device() \
+        + step.opt_state_bytes_per_device()
+    assert memory.peak_bytes() == fake['peak_bytes_in_use']
+
+
+def test_gauges_exported_when_telemetry_armed():
+    telemetry.enable()
+    memory.enable()
+    _net, step, (x, y) = _dense_step()
+    step(x, y)
+    live = telemetry.value('mxnet_tpu_memory_device_bytes',
+                           source='fallback')
+    assert live == step.param_bytes_per_device() \
+        + step.opt_state_bytes_per_device()
+    assert telemetry.value('mxnet_tpu_memory_pool_bytes',
+                           pool='params') \
+        == step.param_bytes_per_device()
+    assert telemetry.value('mxnet_tpu_memory_samples_total') >= 1
+    assert telemetry.value('mxnet_tpu_memory_host_rss_bytes') > 0
+
+
+def test_dead_step_pools_retire():
+    """A dropped step must stop counting (weakref retirement) — a
+    rebuilt step would otherwise double-count its predecessor."""
+    memory.enable()
+    _net, step, (x, y) = _dense_step()
+    step(x, y)
+    before, _ = memory.live_bytes()
+    assert before > 0
+    del step, _net, x, y
+    import gc
+    gc.collect()
+    after, _ = memory.live_bytes()
+    assert after == 0
+
+
+# ---------------------------------------------------------------------------
+# memory_analysis: bucket table reconstructs the measured peak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('zero', [0, 1, 3])
+def test_memory_analysis_bucket_sum_reconstructs_peak(zero):
+    """Acceptance criterion: on the tiny-BERT CPU step the bucket sum
+    (params / optimizer_state / residuals / io_leases /
+    activations_temp-as-residual) equals the measured fallback peak
+    exactly, at every ZeRO stage."""
+    memory.enable()
+    _model, step, (inputs, labels) = _tiny_bert_step(zero=zero)
+    for _ in range(2):
+        step(inputs, labels)
+    rep = step.memory_analysis()
+    assert sum(rep['buckets_bytes'].values()) \
+        == rep['peak_bytes_per_device']
+    assert rep['bucket_sum_over_peak'] == 1.0
+    assert rep['zero_stage'] == (zero if zero else 0)
+    assert rep['buckets_bytes']['params'] \
+        == step.param_bytes_per_device()
+    assert rep['buckets_bytes']['optimizer_state'] \
+        == step.opt_state_bytes_per_device()
+    # the per-layer table covers every trainable param's bytes
+    assert rep['per_layer_bytes']
+    assert sum(rep['per_layer_bytes'].values()) >= \
+        rep['buckets_bytes']['params'] * 0.9
+    if zero == 3:
+        assert rep['gather_bytes_per_layer']
+
+
+def test_memory_analysis_zero_shrink_is_measured():
+    """The ZeRO state/param shrink read straight off the MEASURED
+    buckets (not the analytic byte-counting): zero1 shrinks
+    optimizer_state ~1/dp, zero3 additionally shrinks params."""
+    reps = {}
+    for zero in (0, 1, 3):
+        memory.clear()
+        memory.enable()
+        _m, step, (inputs, labels) = _tiny_bert_step(zero=zero)
+        step(inputs, labels)
+        reps[zero] = step.memory_analysis()['buckets_bytes']
+    assert reps[0]['optimizer_state'] > 4 * reps[1]['optimizer_state']
+    assert reps[1]['params'] > 4 * reps[3]['params']
+
+
+def test_memory_analysis_xla_join_available_on_cpu():
+    memory.enable()
+    _m, step, (inputs, labels) = _tiny_bert_step()
+    step(inputs, labels)
+    rep = step.memory_analysis()
+    assert rep['xla'], "this jaxlib exposes CompiledMemoryStats on CPU"
+    assert rep['xla']['argument_size_in_bytes'] > 0
+    assert 'temp_size_in_bytes' in rep['xla']
+
+
+def test_memory_analysis_peak_override_and_residual_bucket():
+    """An explicit (allocator-measured) peak larger than the persistent
+    pools lands in the activations_temp residual bucket — the memory
+    analog of compute-as-residual in the wall-time report."""
+    memory.enable()
+    _net, step, (x, y) = _dense_step()
+    step(x, y)
+    persistent = step.param_bytes_per_device() \
+        + step.opt_state_bytes_per_device()
+    rep = step.memory_analysis(peak_bytes=persistent + 1000)
+    assert rep['buckets_bytes']['activations_temp'] == 1000
+    assert sum(rep['buckets_bytes'].values()) \
+        == rep['peak_bytes_per_device'] == persistent + 1000
+    assert rep['measured_fraction'] < 1.0
+
+
+def test_format_memory_table_renders():
+    memory.enable()
+    _m, step, (inputs, labels) = _tiny_bert_step()
+    step(inputs, labels)
+    table = attribution.format_memory_table(step.memory_analysis())
+    assert 'activations_temp' in table
+    assert 'params' in table and 'optimizer_state' in table
+    assert 'MB/device' in table
+    assert attribution.format_memory_table(None).startswith('memory:')
+
+
+# ---------------------------------------------------------------------------
+# leak detector
+# ---------------------------------------------------------------------------
+
+def test_leak_detector_latches_and_clears():
+    memory.clear(leak_steps=3, leak_bytes=1000)
+    memory.enable()
+    trace.enable()                       # flight notes need the ring
+    size = [0]
+    memory.register_pool('grower', lambda: {'x': size[0]})
+
+    def grow(vals):
+        for i, v in enumerate(vals):
+            size[0] = v
+            memory.sample(step=i)
+
+    grow([1000, 2000, 3000, 4000])       # 3 consecutive growth steps
+    assert memory.leak_state()['latched']
+    notes = [e for e in flight.get().events()
+             if e['kind'] == 'memory.leak_suspected']
+    assert len(notes) == 1
+    assert notes[0]['growth_bytes'] >= 3000
+    # still growing: stays latched, does NOT re-note
+    grow([5000])
+    assert memory.leak_state()['latched']
+    assert len([e for e in flight.get().events()
+                if e['kind'] == 'memory.leak_suspected']) == 1
+    # growth stops: latch clears
+    grow([5000])
+    assert not memory.leak_state()['latched']
+    # a fresh leak fires a SECOND note (latch, not one-shot)
+    grow([6000, 7000, 8000, 9000])
+    assert memory.leak_state()['latched']
+    assert len([e for e in flight.get().events()
+                if e['kind'] == 'memory.leak_suspected']) == 2
+
+
+def test_leak_detector_ignores_noise_below_threshold():
+    memory.clear(leak_steps=3, leak_bytes=10 ** 6)
+    memory.enable()
+    size = [0]
+    memory.register_pool('grower', lambda: {'x': size[0]})
+    for i, v in enumerate([100, 200, 300, 400, 500]):
+        size[0] = v
+        memory.sample(step=i)
+    assert not memory.leak_state()['latched']
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def test_alloc_oom_site_registered():
+    assert 'alloc.oom' in faults.sites()
+    with pytest.raises(Exception):
+        faults.arm('alloc.oom', 'hang')  # only 'raise' is meaningful
+
+
+def test_oom_guard_ignores_ordinary_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    with pytest.raises(ValueError):
+        with memory.oom_guard('step.dispatch'):
+            raise ValueError('not an oom')
+    assert not os.path.exists(memory.default_oom_path())
+
+
+def test_oom_guard_dumps_on_resource_exhausted_text(tmp_path,
+                                                    monkeypatch):
+    """A REAL backend OOM (matched on the XlaRuntimeError text) dumps
+    and re-raises — the guard never swallows the error."""
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    memory.enable()
+    memory.register_pool('big', lambda: {'hog': 12345678})
+    memory.sample(step=1)
+    with pytest.raises(RuntimeError):
+        with memory.oom_guard('step.dispatch'):
+            raise RuntimeError(
+                'RESOURCE_EXHAUSTED: Out of memory while trying to '
+                'allocate 17179869184 bytes.')
+    with open(memory.default_oom_path()) as f:
+        doc = json.load(f)
+    assert memory.validate_oom_dump(doc) == []
+    assert doc['site'] == 'step.dispatch'
+    assert doc['top_arrays'][0]['name'] == 'hog'
+    assert doc['pools_bytes']['big'] == 12345678
+    assert doc['watermarks']
+
+
+class _DeletedArray:
+    """Mimics a jax array whose buffer was DONATED to the compiled step
+    and invalidated before the OOM surfaced: every data access raises
+    RuntimeError (not AttributeError — getattr does not save you)."""
+
+    @property
+    def addressable_shards(self):
+        raise RuntimeError('Array has been deleted.')
+
+    @property
+    def nbytes(self):
+        raise RuntimeError('Array has been deleted.')
+
+
+def test_oom_dump_survives_donated_deleted_arrays(tmp_path, monkeypatch):
+    """A REAL step-dispatch OOM fires after the compiled call already
+    invalidated its donated inputs — exactly the tracked pools. The
+    forensics dump must survive the deleted buffers (count them 0, keep
+    the live ones), never die inside its own accounting."""
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    memory.enable()
+    memory.register_pool('donated', lambda: {'dead': _DeletedArray(),
+                                             'alive': 777})
+    assert memory.entry_nbytes(_DeletedArray()) == 0
+    assert memory.live_bytes()[0] == 777
+    with pytest.raises(RuntimeError):
+        with memory.oom_guard('step.dispatch'):
+            raise RuntimeError(
+                'RESOURCE_EXHAUSTED: Out of memory while trying to '
+                'allocate 1 bytes.')
+    with open(memory.default_oom_path()) as f:
+        doc = json.load(f)
+    assert memory.validate_oom_dump(doc) == []
+    assert doc['pools_bytes']['donated'] == 777
+    assert doc['top_arrays'][0]['name'] == 'alive'
+
+
+def test_oom_drill_end_to_end(tmp_path):
+    """The alloc.oom drill: injected RESOURCE_EXHAUSTED at a guarded
+    dispatch site leaves a schema-valid forensics dump naming the
+    largest live allocation, with the flight note landed."""
+    from mxnet_tpu.resilience.drill import run_oom_drill
+    out = run_oom_drill(str(tmp_path))
+    assert out['ok']
+    assert out['site'] in ('step.dispatch', 'h2d.batch_put',
+                           'h2d.param_place', 'io.device_put')
+    assert out['top_array']['nbytes'] > 0
+    assert out['watermark_samples'] >= 1
+    assert out['flight_noted']
+    with open(out['path']) as f:
+        doc = json.load(f)
+    assert memory.validate_oom_dump(doc) == []
+
+
+def test_oom_dump_carries_what_would_fit_hints(tmp_path, monkeypatch):
+    """dp=8 at zero stage 0: the hint table must project the ZeRO-1
+    state shrink and the ZeRO-3 param shrink (and rank them by bytes
+    freed) — the actionable half of the post-mortem."""
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    memory.enable()
+    _net, step, (x, y) = _dense_step(zero=0)
+    step(x, y)
+    faults.arm('alloc.oom', 'raise', window=1)
+    with pytest.raises(faults.InjectedFault):
+        step(x, y)
+    faults.disarm()
+    with open(memory.default_oom_path()) as f:
+        doc = json.load(f)
+    actions = [h['action'] for h in doc['hints']]
+    assert 'MXTPU_ZERO=1' in actions and 'MXTPU_ZERO=3' in actions
+    savings = [h['projected_savings_bytes'] for h in doc['hints']]
+    assert savings == sorted(savings, reverse=True)
+    assert all(s > 0 for s in savings)
+    assert doc['config']['MXTPU_ZERO'] in ('0', '1')
+
+
+def test_validate_oom_dump_rejects_malformed():
+    assert memory.validate_oom_dump('nope')
+    good_enough = {k: 0 for k in (
+        'schema', 'pid', 'time', 'site', 'error', 'error_type',
+        'device_bytes', 'source', 'peak_bytes', 'host_rss_bytes')}
+    good_enough.update(schema=memory.OOM_SCHEMA, pools_bytes={},
+                       watermarks=[], hints=[], config={},
+                       top_arrays=[{'pool': 'p', 'name': 'a',
+                                    'nbytes': 1},
+                                   {'pool': 'p', 'name': 'b',
+                                    'nbytes': 2}])
+    probs = memory.validate_oom_dump(good_enough)
+    assert any('sorted' in p for p in probs)
+    good_enough['top_arrays'].reverse()
+    assert memory.validate_oom_dump(good_enough) == []
+    bad = dict(good_enough)
+    del bad['watermarks']
+    assert any('watermarks' in p for p in memory.validate_oom_dump(bad))
+
+
+# ---------------------------------------------------------------------------
+# fleet HBM imbalance + healthz
+# ---------------------------------------------------------------------------
+
+def _snap(step, mem_live):
+    return {'time': 0.0, 'step': step, 'wall_ms': 100.0,
+            'mem': {'live': mem_live, 'peak': mem_live, 'rss': 1000}}
+
+
+def test_fleet_flags_fat_rank_hbm_imbalance():
+    mon = fleet.FleetMonitor(memory_imbalance_factor=1.5,
+                             stale_seconds=60.0)
+    fired = []
+    for s in range(1, 4):
+        fired += mon.ingest(0, _snap(s, 100 * 2 ** 20))
+        fired += mon.ingest(1, _snap(s, 250 * 2 ** 20))
+    kinds = [k for k, _i in fired]
+    assert kinds.count('fleet.memory_imbalance') == 1   # latched
+    info = dict(fired)['fleet.memory_imbalance']
+    assert info['rank'] == 1                            # the FAT rank
+    assert info['ratio'] == 2.5
+    view = mon.view()
+    assert view['ranks'][1]['memory_bytes'] == 250 * 2 ** 20
+    assert 'fleet.memory_imbalance' in view['ranks'][1]['flags']
+    assert 'fleet.memory_imbalance' not in view['ranks'][0]['flags']
+
+
+def test_fleet_imbalance_flag_clears_when_ranks_rebalance():
+    mon = fleet.FleetMonitor(memory_imbalance_factor=1.5,
+                             stale_seconds=60.0)
+    mon.ingest(0, _snap(1, 100 * 2 ** 20))
+    fired = mon.ingest(1, _snap(1, 250 * 2 ** 20))
+    assert [k for k, _ in fired] == ['fleet.memory_imbalance']
+    fired = mon.ingest(1, _snap(2, 110 * 2 ** 20))
+    assert 'fleet.memory_imbalance' not in [k for k, _ in fired]
+    assert 'fleet.memory_imbalance' not in mon.view()['ranks'][1]['flags']
+    # re-offense fires again (the latch cleared)
+    fired = mon.ingest(1, _snap(3, 300 * 2 ** 20))
+    assert 'fleet.memory_imbalance' in [k for k, _ in fired]
+
+
+def test_fleet_memory_flag_unlatches_when_peer_departs():
+    """A lone reporter is uncomparable, not balanced: when the thin
+    peer departs, the fat survivor's flag must clear — a stale latch
+    would swallow its next genuine offense forever (the PR 12
+    stale-latch class; the comm detector shares the fix)."""
+    mon = fleet.FleetMonitor(memory_imbalance_factor=1.5,
+                             stale_seconds=60.0)
+    mon.ingest(0, _snap(1, 100 * 2 ** 20))
+    fired = mon.ingest(1, _snap(1, 250 * 2 ** 20))
+    assert 'fleet.memory_imbalance' in [k for k, _ in fired]
+    mon.remove_ranks([0])
+    mon.ingest(1, _snap(2, 250 * 2 ** 20))      # lone reporter
+    assert 'fleet.memory_imbalance' \
+        not in mon.view()['ranks'][1]['flags']
+    # a fresh thin peer arrives: the offense fires AGAIN (not
+    # latch-swallowed; fleet-wide detector — it may fire on either
+    # rank's ingest, whichever first sees both reporters)
+    fired = mon.ingest(2, _snap(1, 100 * 2 ** 20))
+    fired += mon.ingest(1, _snap(3, 250 * 2 ** 20))
+    kinds = dict(fired)
+    assert 'fleet.memory_imbalance' in kinds
+    assert kinds['fleet.memory_imbalance']['rank'] == 1
+
+
+def test_fleet_memory_gauge_mirrors_rank_snapshot():
+    telemetry.enable()
+    mon = fleet.FleetMonitor(stale_seconds=60.0)
+    mon.ingest(3, _snap(1, 77777))
+    assert telemetry.value('mxnet_tpu_fleet_memory_bytes', rank=3) \
+        == 77777
+    mon.remove_ranks([3])
+    assert telemetry.value('mxnet_tpu_fleet_memory_bytes', rank=3) \
+        is None
+
+
+def test_local_snapshot_carries_memory_when_armed():
+    telemetry.enable()
+    memory.enable()
+    memory.register_pool('p', lambda: {'x': 4242})
+    memory.sample(step=1)
+    snap = fleet.local_snapshot()
+    assert snap['mem'] == {'live': 4242, 'peak': 4242,
+                           'rss': snap['mem']['rss']}
+    memory.disable()
+    snap = fleet.local_snapshot()
+    assert 'mem' not in snap
+
+
+def test_healthz_reports_memory_pressure():
+    """/healthz carries live/peak memory even on a run that never armed
+    MXTPU_MEMORY — the operator sees pressure BEFORE the OOM."""
+    telemetry.enable()
+    memory.register_pool('p', lambda: {'x': 5150})
+    srv = server.TelemetryServer(port=0)
+    try:
+        doc = srv.health()
+    finally:
+        srv.stop()
+    assert doc['memory']['tracked_bytes'] == 5150
+    assert doc['memory']['live_bytes'] >= 5150 \
+        or doc['memory']['source'] == 'memory_stats'
+    assert doc['memory']['host_rss_bytes'] > 0
+    assert doc['memory']['peak_bytes'] >= doc['memory']['tracked_bytes'] \
+        or doc['memory']['source'] == 'memory_stats'
+
+
+# ---------------------------------------------------------------------------
+# bench "memory" field contract (alongside test_bench_contract.py)
+# ---------------------------------------------------------------------------
+
+def test_bench_memory_report_contract():
+    """bench.py's ``"memory"`` field: peak/live watermark, bucket table
+    whose sum reconstructs the peak, and the memory_analysis
+    availability flags — the driver-artifact contract for BENCH
+    rounds."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, 'bench.py')
+    spec = importlib.util.spec_from_file_location('bench_mem_test', path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    _net, step, (x, y) = _dense_step()
+    step(x, y)                            # compile outside the report
+    doc = bench._memory_report(step, lambda: step(x, y), steps=2)
+    for k in ('samples', 'live_bytes_per_device', 'peak_bytes_per_device',
+              'host_rss_bytes', 'source', 'memory_analysis_available',
+              'xla_memory_analysis_available', 'buckets_bytes',
+              'bucket_sum_over_peak', 'zero_stage'):
+        assert k in doc, k
+    assert doc['samples'] == 2
+    assert doc['memory_analysis_available'] is True
+    assert doc['source'] in ('fallback', 'memory_stats')
+    assert sum(doc['buckets_bytes'].values()) \
+        == doc['peak_bytes_per_device']
+    assert doc['bucket_sum_over_peak'] == 1.0
+    assert json.loads(json.dumps(doc)) == doc   # JSON-line safe
+    # the report restores the disarmed state (bench A/Bs depend on it)
+    assert not memory.enabled()
+    assert memory.watermarks() == []

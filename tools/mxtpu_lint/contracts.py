@@ -146,9 +146,31 @@ SUBSYSTEM_METRICS = {
         # value IS the remote counter's, so the two scrapes agree
         # exactly — dryrun_multichip asserts it)
         'mxnet_tpu_fleet_comm_bytes': 'gauge',
+        # mirrors each rank's live device-memory watermark from the
+        # heartbeat-piggybacked memory snapshot (ISSUE 14) — the number
+        # the HBM-imbalance detector compares across ranks
+        'mxnet_tpu_fleet_memory_bytes': 'gauge',
         # streaming anomaly detectors (kind + rank labels): straggler
         # skew / step-time regression / loss spike / comm imbalance
         'mxnet_tpu_fleet_anomalies_total': 'counter',
+    },
+    'mxnet_tpu_memory_': {
+        # memory observability (ISSUE 14): per-step watermark sampling
+        # (MXTPU_MEMORY) — live/peak device bytes by source
+        # ('memory_stats' where the backend exposes its allocator,
+        # 'fallback' = deterministic per-device sum over the tracked
+        # live arrays), host RSS, and the per-pool residency breakdown
+        # (params / optimizer_state / residuals / io_leases) the
+        # memory_analysis() bucket table reads
+        'mxnet_tpu_memory_device_bytes': 'gauge',
+        'mxnet_tpu_memory_device_peak_bytes': 'gauge',
+        'mxnet_tpu_memory_host_rss_bytes': 'gauge',
+        'mxnet_tpu_memory_pool_bytes': 'gauge',
+        'mxnet_tpu_memory_samples_total': 'counter',
+        # step-over-step growth detector latches + OOM forensics dumps
+        # (by dispatch site)
+        'mxnet_tpu_memory_leaks_suspected_total': 'counter',
+        'mxnet_tpu_memory_oom_dumps_total': 'counter',
     },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
@@ -244,6 +266,10 @@ FLIGHT_NOTE_NAMES = frozenset({
     # fleet anomaly detectors (ISSUE 13)
     'fleet.straggler', 'fleet.step_regression', 'fleet.loss_spike',
     'fleet.comm_imbalance',
+    # memory observability (ISSUE 14): the leak detector's latched
+    # note, the OOM forensics dump marker, and the coordinator-side
+    # per-rank HBM-imbalance flag
+    'memory.leak_suspected', 'memory.oom', 'fleet.memory_imbalance',
 })
 
 # ---------------------------------------------------------------------------

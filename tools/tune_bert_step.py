@@ -115,8 +115,9 @@ def main():
         # (so the clean timed number above stays untouched): measured
         # wall buckets + XLA cost_analysis, the honest-MFU decomposition
         # PERF_NOTES.md cites (telemetry/attribution.py)
-        from mxnet_tpu.telemetry import attribution, flight, trace
+        from mxnet_tpu.telemetry import attribution, flight, memory, trace
         trace.enable()
+        memory.enable()
         flight.get().clear()
         for _ in range(6):
             float(step(inputs, [labels, nsp]).asnumpy())
@@ -129,6 +130,11 @@ def main():
         if xla:
             rep['xla_cost_per_step'] = xla
         print(attribution.format_table(rep), flush=True)
+        # the memory half of the same attribution pipeline (ISSUE 14):
+        # per-device residency buckets next to the wall-time buckets —
+        # what the remat-policy sweep spends is what this measures
+        print(attribution.format_memory_table(step.memory_analysis()),
+              flush=True)
         span_path = os.path.join(args.trace, 'mxtpu_spans.json')
         trace.dump(span_path)
         print(f"span trace written to {span_path} "
