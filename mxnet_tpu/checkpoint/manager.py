@@ -278,16 +278,22 @@ class CheckpointManager:
         """Where the last restore's bytes came from: None (plain local
         restore) or the replica source description (e.g.
         ``hosted:rank0``) when the any-replica fallback fetched them."""
-        return self._replica.last_restore_source \
+        return self._replica.restore_source() \
             if self._replica is not None else None
 
     def attach_replication(self, replica_manager) -> None:
         """Attach an explicitly constructed
         ``checkpoint.replica.ReplicaManager`` (tests, drills, custom
-        peer worlds). Replaces — and closes — any auto-attached one."""
-        if self._replica is not None and self._replica is not replica_manager:
-            self._replica.close()
-        self._replica = replica_manager
+        peer worlds). Replaces — and closes — any auto-attached one.
+        The swap happens under the manager lock — the background writer
+        reads ``_replica`` mid-commit under the same lock, and must see
+        the old manager or the new one, never tear between the close
+        and the rebind. close() runs after release (it joins the old
+        push worker, which may itself be waiting on manager state)."""
+        with self._lock:
+            old, self._replica = self._replica, replica_manager
+        if old is not None and old is not replica_manager:
+            old.close()
 
     # -- save -------------------------------------------------------------
 
@@ -696,7 +702,10 @@ class CheckpointManager:
 
     def _try_replica_repair(self, step) -> bool:
         """Quarantine one corrupt local step and re-fetch it from a
-        healthy replica (restore-time twin of the scrubber's repair)."""
+        healthy replica (restore-time twin of the scrubber's repair).
+        True iff the step is intact again (the replica manager's
+        source description is coerced — callers that want WHERE the
+        repair came from use ``last_restore_source``)."""
         d = self.step_dir(step)
         q = f'{d}.quarantine-{os.getpid()}'
         try:
@@ -707,7 +716,7 @@ class CheckpointManager:
         except OSError:
             pass
         try:
-            return self._replica.repair_step(step)
+            return bool(self._replica.repair_step(step))
         except Exception as e:
             warnings.warn(f"replica repair of step {step} failed: {e!r}",
                           RuntimeWarning)
@@ -860,9 +869,13 @@ class CheckpointManager:
         """Flush the in-flight write and unhook signals (and shut the
         replication worker + scrubber + replica server down)."""
         self.wait()
-        if self._replica is not None:
-            self._replica.close()
-            self._replica = None
+        # detach under the manager lock (the background writer reads
+        # _replica mid-commit under it), close after release — close()
+        # joins the push worker, which must not deadlock on our lock
+        with self._lock:
+            replica, self._replica = self._replica, None
+        if replica is not None:
+            replica.close()
         self.uninstall_preemption_hook()
 
     def __enter__(self):

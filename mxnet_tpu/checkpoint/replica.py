@@ -80,8 +80,12 @@ def active_fetches() -> int:
     """How many replica-transport fetches are in flight process-wide.
     ``resilience.elastic.stall_verdict`` consults this so a training
     stall DURING a replica fetch classifies as peer loss suspected
-    (the serving peer is the prime suspect), not a bare local stall."""
-    return _active_fetches
+    (the serving peer is the prime suspect), not a bare local stall.
+    Read under the same lock the counter mutates under — the callers
+    are crash-time verdict paths where a torn read would misclassify
+    the stall."""
+    with _fetch_lock:
+        return _active_fetches
 
 
 @contextlib.contextmanager
@@ -168,7 +172,7 @@ class ReplicaManager:
         self._peers = [p if isinstance(p, ReplicaPeer) else ReplicaPeer(*p)
                        for p in peers] if peers is not None else None
         self.max_pending = int(max_pending)
-        self.last_restore_source = None
+        self.last_restore_source = None   # guarded by self._cond
         self.push_failures = 0
         self.dropped = 0
         self._sweep_fetch_tmp()
@@ -311,6 +315,13 @@ class ReplicaManager:
         steps = [int(s) for s in steps]
         if steps:
             self._enqueue_item(('gc', steps))
+
+    def restore_source(self):
+        """Where the newest replica restore/repair came from (e.g.
+        ``hosted:rank0``), or None — read under the same lock the fetch
+        paths (training-thread restore, scrubber repair) write it."""
+        with self._cond:
+            return self.last_restore_source
 
     def wait(self, timeout=30.0):
         """Block until the push queue is drained and the worker idle
@@ -500,7 +511,10 @@ class ReplicaManager:
     def repair_step(self, step):
         """Repair ONE local step from a healthy replica (scrubber /
         restore-time corruption): quarantine whatever is there, fetch,
-        verify, commit. Returns True when the step is intact again."""
+        verify, commit. Returns the source description the repair came
+        from (truthy) or None — callers that report the source use the
+        RETURN value, not a re-read of ``last_restore_source`` (the
+        training thread's restore path writes that attribute too)."""
         with _fetching():
             sources = self.restore_sources()
             return self._fetch_step(int(step), sources)
@@ -517,7 +531,11 @@ class ReplicaManager:
                 _log.warning("replica fetch of step %d from %s failed, "
                              "trying next source: %s", step, desc, e)
                 continue
-            self.last_restore_source = desc
+            # under the queue condition lock: the scrubber thread and a
+            # training-thread restore can both land here, and the drills
+            # read the attribute after wait()
+            with self._cond:
+                self.last_restore_source = desc
             if _telem['on']:
                 from .. import telemetry as _telemetry
                 _telemetry.inc(
@@ -527,8 +545,8 @@ class ReplicaManager:
             _log.warning(
                 "checkpoint step %d restored from replica source %s "
                 "(%d bytes, hash-verified)", step, desc, total)
-            return True
-        return False
+            return desc
+        return None
 
     def _fetch_step_into(self, src, step, final):
         """Fetch one step from one source into a staging dir next to
@@ -669,11 +687,12 @@ class ReplicaManager:
                        "quarantining and repairing from a replica",
                        step, problem)
             self._quarantine_dir(d)
-            if self.repair_step(step):
+            repaired_from = self.repair_step(step)
+            if repaired_from:
                 summary['repaired'] += 1
                 self._count_repaired()
                 _note('checkpoint.repair', step=int(step), where='local',
-                      source=self.last_restore_source)
+                      source=repaired_from)
         # -- hosted replicas (+ orphan GC against the owner's inventory)
         root = os.path.join(self.manager.directory, mf.REPLICA_SUBDIR)
         for ns in mf.replica_namespaces(self.manager.directory):

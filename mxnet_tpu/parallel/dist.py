@@ -44,6 +44,12 @@ _log = logging.getLogger('mxnet_tpu.dist')
 
 _initialized = False
 _membership = None
+# publication lock for the process-global membership: membership() is
+# read from the watchdog/elastic-monitor/endpoint threads while
+# start_/stop_membership swap the reference on the main thread. RLock
+# by the signal-safety rationale: membership() is reachable from the
+# SIGTERM preemption path (manifest `world` metadata).
+_membership_lock = threading.RLock()
 
 
 def _resolve_world(coordinator=None, num_processes=None, process_id=None,
@@ -471,12 +477,18 @@ class Membership:
             t.join(timeout=max(1.0, 2 * self.heartbeat_seconds))
         self._threads = []
         self._beating = False
-        if self._server is not None:
+        # retire the socket under the lock: a server thread that
+        # outlived its join timeout (wedged handler) reads the handle
+        # through the same lock, so it sees either the live socket
+        # (accept then raises OSError on the close) or None — never a
+        # torn in-between
+        with self._lock:
+            srv, self._server = self._server, None
+        if srv is not None:
             try:
-                self._server.close()
+                srv.close()
             except OSError:
                 pass
-            self._server = None
 
     def __enter__(self):
         return self
@@ -488,9 +500,11 @@ class Membership:
     # -- coordinator server (rank 0) ---------------------------------------
 
     def _serve(self):
-        while not self._stop.is_set():
+        with self._lock:
+            srv = self._server
+        while srv is not None and not self._stop.is_set():
             try:
-                conn, _addr = self._server.accept()
+                conn, _addr = srv.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -722,10 +736,15 @@ class Membership:
     def _request(self, msg, timeout=None):
         timeout = timeout if timeout is not None else \
             max(1.0, self.heartbeat_seconds * 2)
+        # snapshot the endpoint under the lock: retarget() (a re-form
+        # pointing at the promoted coordinator) updates host+port as a
+        # pair, and a beat racing it must not connect to the OLD host
+        # with the NEW port
+        with self._lock:
+            host, port = self.coordinator_host, self.port
         try:
             with socket.create_connection(
-                    (self.coordinator_host, self.port),
-                    timeout=timeout) as conn:
+                    (host, port), timeout=timeout) as conn:
                 with conn.makefile('rwb') as f:
                     f.write(json.dumps(msg).encode() + b'\n')
                     f.flush()
@@ -736,7 +755,7 @@ class Membership:
                 self.send_failures += 1
             raise MXNetError(
                 f"membership: coordinator "
-                f"{self.coordinator_host}:{self.port} unreachable: "
+                f"{host}:{port} unreachable: "
                 f"{e!r}") from e
         with self._lock:
             self._view = view
@@ -868,6 +887,7 @@ class Membership:
             return self
         alive = self.alive()
         with self._lock:
+            # lint: lockset-race-ok monotonic False->True promotion latch; a reader seeing the stale False for one beat retries against the dead coordinator once and self-corrects on the next round-trip
             self.is_coordinator = True
             now = _time.monotonic()
             self._last_beat = {r: now for r in alive}
@@ -938,7 +958,8 @@ class Membership:
 
 def membership():
     """The process-global Membership (None unless started)."""
-    return _membership
+    with _membership_lock:
+        return _membership
 
 
 def start_membership(coordinator=None, num_processes=None, process_id=None,
@@ -957,8 +978,10 @@ def start_membership(coordinator=None, num_processes=None, process_id=None,
     host = coordinator.rsplit(':', 1)[0] if ':' in coordinator \
         else coordinator
     kwargs.setdefault('port', _elastic_port(coordinator))
-    _membership = Membership(process_id, num_processes,
-                             coordinator_host=host, **kwargs)
+    ms = Membership(process_id, num_processes,
+                    coordinator_host=host, **kwargs)
+    with _membership_lock:
+        _membership = ms
     # fleet observability (ISSUE 13): heartbeats piggyback telemetry
     # snapshots, the coordinator merges them, and the per-process
     # /metrics//healthz//flight endpoint arms iff MXTPU_METRICS_PORT
@@ -975,9 +998,10 @@ def start_membership(coordinator=None, num_processes=None, process_id=None,
 
 def stop_membership():
     global _membership
-    if _membership is not None:
-        _membership.stop()
-        _membership = None
+    with _membership_lock:
+        ms, _membership = _membership, None
+    if ms is not None:
+        ms.stop()
 
 
 def barrier(tag='barrier', timeout=None):
@@ -1116,12 +1140,17 @@ class ReplicaServer:
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads = []
-        if self._server is not None:
+        # retire the socket under the lock (same discipline as
+        # Membership.stop): an accept loop that outlived its join
+        # timeout must read the live-socket-or-None pair, never a torn
+        # in-between
+        with self._lock:
+            srv, self._server = self._server, None
+        if srv is not None:
             try:
-                self._server.close()
+                srv.close()
             except OSError:
                 pass
-            self._server = None
 
     def __enter__(self):
         return self
@@ -1149,9 +1178,11 @@ class ReplicaServer:
     # -- server loop -------------------------------------------------------
 
     def _serve(self):
-        while not self._stop.is_set():
+        with self._lock:
+            srv = self._server
+        while srv is not None and not self._stop.is_set():
             try:
-                conn, _addr = self._server.accept()
+                conn, _addr = srv.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -1302,7 +1333,10 @@ class ReplicaServer:
                     shutil.rmtree(stepdir, ignore_errors=True)
                     removed = 1
             if removed:
-                self.gc_total += 1
+                # one handler thread per connection: the counter bump
+                # must not lose updates between concurrent deletes
+                with self._lock:
+                    self.gc_total += 1
                 if _telem['on']:
                     from .. import telemetry as _telemetry
                     _telemetry.inc(

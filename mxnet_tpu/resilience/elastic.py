@@ -230,7 +230,11 @@ class ElasticController:
         self._old_handlers = {}
         self._monitor = None
         self._monitor_stop = threading.Event()
+        # suspected-lost ranks: mutated by the monitor thread (update)
+        # AND the training thread's _reform (clear after recovery) —
+        # both sides go through _suspected_lock
         self._suspected = set()
+        self._suspected_lock = threading.Lock()
 
     # -- wiring ------------------------------------------------------------
 
@@ -329,13 +333,15 @@ class ElasticController:
             if ms is None:
                 continue
             try:
-                lost = [r for r in ms.lost_peers()
-                        if r not in self._suspected]
+                lost_now = ms.lost_peers()
             except Exception:
                 continue
+            with self._suspected_lock:
+                lost = [r for r in lost_now
+                        if r not in self._suspected]
+                self._suspected.update(lost)
             if not lost:
                 continue
-            self._suspected.update(lost)
             v = stall_verdict(ms) or {}
             _log.error(
                 "elastic monitor: peer(s) %s silent past the %.1fs "
@@ -491,7 +497,8 @@ class ElasticController:
                 fn(mesh)
         dt = _time.perf_counter() - t0
         self.reforms += 1
-        self._suspected -= set(lost)
+        with self._suspected_lock:
+            self._suspected -= set(lost)
         self.last_reform = {
             'lost': list(lost),
             'world': new_world,

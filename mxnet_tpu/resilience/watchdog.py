@@ -193,8 +193,11 @@ class StepWatchdog:
                 # nothing has told the manager a step yet (e.g. a stall
                 # in the very first batch): fall back to the heartbeat
                 # step, or 0 — an initial-state checkpoint still beats
-                # losing the run
-                step = self.last_step if self.last_step is not None else 0
+                # losing the run. last_step is beat()'s state: this
+                # save thread reads it under the same lock.
+                with self._lock:
+                    last = self.last_step
+                step = last if last is not None else 0
             self.manager.save_now(step)
             _log.warning("watchdog: emergency checkpoint committed at "
                          "step %s", step)

@@ -59,7 +59,14 @@ class FlightRecorder:
         self.capacity = max(1, int(capacity))
         self._steps = collections.deque(maxlen=self.capacity)
         self._events = collections.deque(maxlen=int(event_capacity))
-        self._lock = threading.Lock()
+        # RLock, same signal-safety rationale as the module-level
+        # _recorder_lock: note() and _pop_pending() run inside the
+        # SIGTERM preemption save and the atexit dump — a signal
+        # landing while THIS thread holds the ring lock (record_step's
+        # critical section) must re-enter, not self-deadlock. Found by
+        # mxtpu_lint's signal-safety rule once the call graph learned
+        # to resolve `get().note(...)` through the accessor.
+        self._lock = threading.RLock()
         self._last_t = None          # perf_counter of the previous step
         self._pending_loss = None    # (record, device scalar) to resolve
         self.dumps = 0
@@ -149,11 +156,11 @@ class FlightRecorder:
     @contextlib.contextmanager
     def _locked_for_dump(self, timeout=2.0):
         """Best-effort lock for the read/dump paths. A crash-time dump
-        must never deadlock: a fatal-signal handler can interrupt THIS
-        thread while it holds the (non-reentrant) lock, and a wedged
-        holder on another thread must not wedge the watchdog's report.
-        After `timeout` we proceed lock-free — safe, because a holder
-        that timed us out is interrupted or blocked, not mutating."""
+        must never deadlock: same-thread signal re-entry is covered by
+        the ring lock being an RLock, but a wedged holder on ANOTHER
+        thread must not wedge the watchdog's report. After `timeout`
+        we proceed lock-free — safe, because a holder that timed us
+        out is interrupted or blocked, not mutating."""
         got = self._lock.acquire(timeout=timeout)
         try:
             yield
@@ -224,7 +231,11 @@ class FlightRecorder:
             path = default_dump_path()
         doc = self.snapshot(resolve_loss=False, signal_safe=signal_safe)
         doc['reason'] = reason or 'manual'
-        self.dumps += 1
+        # the watchdog's stall dump and an atexit/SIGTERM dump can
+        # overlap; the counter bump rides the same crash-tolerant lock
+        # as the ring reads (timeout, then proceed — never wedge a dump)
+        with self._locked_for_dump():
+            self.dumps += 1
         if _telem['on'] and not signal_safe:
             from . import metrics as _metrics
             _metrics.inc('mxnet_tpu_trace_flight_dumps_total')

@@ -61,6 +61,9 @@ class TelemetryServer:
         self._server = None
         self._thread = None
         self.port = int(port)
+        # up to max_handlers handler threads bump the request counter
+        # concurrently — a bare += would silently lose counts
+        self._lock = threading.Lock()
         self.requests = 0
         if start:
             self.start()
@@ -89,12 +92,16 @@ class TelemetryServer:
         if t is not None:
             t.join(timeout=2.0)
         self._thread = None
-        if self._server is not None:
+        # retire the socket under the lock: an accept loop that
+        # outlived its join timeout reads the handle through the same
+        # lock — live socket or None, never a torn in-between
+        with self._lock:
+            srv, self._server = self._server, None
+        if srv is not None:
             try:
-                self._server.close()
+                srv.close()
             except OSError:
                 pass
-            self._server = None
 
     def __enter__(self):
         return self
@@ -106,9 +113,11 @@ class TelemetryServer:
     # -- accept loop -------------------------------------------------------
 
     def _serve(self):
-        while not self._stop.is_set():
+        with self._lock:
+            srv = self._server
+        while srv is not None and not self._stop.is_set():
             try:
-                conn, _addr = self._server.accept()
+                conn, _addr = srv.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -144,7 +153,8 @@ class TelemetryServer:
                 path = self._read_request(conn)
                 if path is None:
                     return
-                self.requests += 1
+                with self._lock:
+                    self.requests += 1
                 status, ctype, body = self._route(path)
                 head = (f'HTTP/1.0 {status}\r\n'
                         f'Content-Type: {ctype}\r\n'

@@ -90,7 +90,10 @@ def _ring_capacity() -> int:
     global _DEFAULT_RING
     if _DEFAULT_RING is None:
         from .. import config as _config
-        _DEFAULT_RING = max(16, int(_config.get('MXTPU_TRACE_RING')))
+        with _rings_lock:
+            if _DEFAULT_RING is None:
+                _DEFAULT_RING = max(
+                    16, int(_config.get('MXTPU_TRACE_RING')))
     return _DEFAULT_RING
 
 
@@ -99,7 +102,8 @@ def set_ring_capacity(n):
     None to restore the MXTPU_TRACE_RING config default). clear() drops
     existing rings, so tests set capacity + clear to take effect."""
     global _DEFAULT_RING
-    _DEFAULT_RING = None if n is None else max(16, int(n))
+    with _rings_lock:
+        _DEFAULT_RING = None if n is None else max(16, int(n))
 
 
 class _Ring:
@@ -128,7 +132,8 @@ class _Ring:
         old = self.events[slot]
         if old is not None and old['ph'] == 'B':
             # overwriting a begin event drops that whole span from the
-            # ring (balance_events drops its orphan 'E' at export)
+            # ring (balance_events drops that span's orphan 'E' at
+            # export)
             self.dropped += 1
         self.events[slot] = ev
         self.n += 1
@@ -219,8 +224,10 @@ class _Span:
 
     def __enter__(self):
         r = _ring()
+        # lint: lockset-race-ok a _Span instance is created, entered and exited by ONE thread (span() builds a fresh instance per use); nothing shares it
         self.ring = r
         t0 = _now_us()
+        # lint: lockset-race-ok same single-thread span instance as above
         self.t0 = t0
         ev = {'name': self.name, 'cat': 'span', 'ph': 'B', 'ts': t0,
               'tid': r.tid}

@@ -1,0 +1,1084 @@
+"""Thread-aware lint rules: lockset-race, blocking-under-lock,
+donation-lifetime (+ the thread model, the stale-suppression audit,
+the incremental cache, --format json) and regression tests for the
+real concurrency fixes the new rules surfaced at HEAD.
+
+Fixture matrix per the issue: true race / locked / lock-free-
+suppressed / cross-module via call edge / factory-spawned thread;
+blocking call with vs without timeout; donated read before vs after
+re-place. Determinism: tools/flakiness_checker.py drives the lockset
+tests 3x — the analysis is a pure function of the source.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+from mxtpu_lint import cache as lint_cache  # noqa: E402
+from mxtpu_lint.core import Baseline, FileIndex, run_rules  # noqa: E402
+from mxtpu_lint.rules.donation import DonationLifetimeRule  # noqa: E402
+from mxtpu_lint.rules.races import (BlockingUnderLockRule,  # noqa: E402
+                                    LocksetRaceRule)
+from mxtpu_lint.threads import ThreadModel, thread_model  # noqa: E402
+
+
+def make_index(tmp_path, files):
+    pkg = tmp_path / 'fixpkg'
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / '__init__.py').write_text('')
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if not (p.parent / '__init__.py').exists():
+            (p.parent / '__init__.py').write_text('')
+        p.write_text(textwrap.dedent(src))
+    return FileIndex(str(pkg))
+
+
+# ---------------------------------------------------------------------------
+# thread model: root discovery + annotation
+# ---------------------------------------------------------------------------
+
+WORKER_SRC = '''
+    import threading
+
+    class Box:
+        def __init__(self):
+            self.count = 0
+            self._lock = threading.Lock()
+
+        def start(self):
+            t = threading.Thread(target=self._run, name='box-worker')
+            t.start()
+
+        def _run(self):
+            self._step()
+
+        def _step(self):
+            self.count += 1
+
+        def read(self):
+            return self.count
+'''
+
+
+def test_thread_root_discovery_and_annotation(tmp_path):
+    idx = make_index(tmp_path, {'box.py': WORKER_SRC})
+    model = ThreadModel(idx)
+    idents = [r.ident for r in model.roots]
+    assert idents == ['thread:fixpkg/box.py::Box._run'], idents
+    assert model.roots[0].display == 'box-worker'
+    run_key = ('fixpkg/box.py', 'Box._run')
+    step_key = ('fixpkg/box.py', 'Box._step')
+    read_key = ('fixpkg/box.py', 'Box.read')
+    assert model.roots_of(run_key) == {idents[0]}
+    assert model.roots_of(step_key) == {idents[0]}
+    assert model.roots_of(read_key) == {'main'}
+
+
+def test_thread_root_factory_closure(tmp_path):
+    idx = make_index(tmp_path, {'fac.py': '''
+        import threading
+
+        def make_worker(q):
+            def worker():
+                q.touch()
+            return worker
+
+        def spawn(q):
+            threading.Thread(target=make_worker(q)).start()
+    '''})
+    model = ThreadModel(idx)
+    assert [r.ident for r in model.roots] == \
+        ['thread:fixpkg/fac.py::make_worker.<locals>.worker']
+
+
+def test_thread_root_local_closure_target(tmp_path):
+    idx = make_index(tmp_path, {'loc.py': '''
+        import threading
+
+        def launch():
+            def worker():
+                pass
+            threading.Thread(target=worker).start()
+    '''})
+    model = ThreadModel(idx)
+    assert [r.ident for r in model.roots] == \
+        ['thread:fixpkg/loc.py::launch.<locals>.worker']
+
+
+def test_thread_root_multi_instance_in_loop(tmp_path):
+    idx = make_index(tmp_path, {'pool.py': '''
+        import threading
+
+        class Pool:
+            def serve(self):
+                while True:
+                    threading.Thread(target=self._handle).start()
+
+            def _handle(self):
+                pass
+    '''})
+    model = ThreadModel(idx)
+    assert model.roots[0].multi is True
+
+
+# ---------------------------------------------------------------------------
+# lockset-race fixture matrix
+# ---------------------------------------------------------------------------
+
+def test_lockset_race_true_race_detected(tmp_path):
+    idx = make_index(tmp_path, {'box.py': WORKER_SRC})
+    found = LocksetRaceRule().run(idx)
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.symbol == 'Box.count'
+    assert 'box-worker' in f.message and 'main' in f.message
+    # no lock is held at any access site: the message says so
+    assert 'no lock is held at ANY access site' in f.message
+    assert f.data['write']['symbol'] == 'Box._step'
+    assert f.data['other']['symbol'] in ('Box.read', 'Box._step')
+
+
+def test_lockset_race_locked_on_both_sides_is_clean(tmp_path):
+    idx = make_index(tmp_path, {'box.py': '''
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.count = 0
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def read(self):
+                with self._lock:
+                    return self.count
+    '''})
+    assert LocksetRaceRule().run(idx) == []
+
+
+def test_lockset_race_lock_free_suppressed(tmp_path):
+    idx = make_index(tmp_path, {'box.py': '''
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                # lint: lockset-race-ok single-writer ring by design
+                self.n += 1
+
+            def read(self):
+                return self.n
+    '''})
+    result = run_rules(idx, [LocksetRaceRule()])
+    assert result.new == []
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0][1] == 'single-writer ring by design'
+
+
+def test_lockset_race_cross_module_via_call_edge(tmp_path):
+    """The write happens in a helper module; the thread reaches it
+    through a call edge — the race must still be attributed to the
+    spawning root."""
+    idx = make_index(tmp_path, {
+        'state.py': '''
+            class State:
+                def __init__(self):
+                    self.total = 0
+
+                def bump(self):
+                    self.total += 1
+
+                def snapshot(self):
+                    return self.total
+        ''',
+        'runner.py': '''
+            import threading
+            from state import State
+
+            def loop(st):
+                st.bump()
+
+            def report(st):
+                return st.snapshot()
+
+            def launch(st):
+                threading.Thread(target=loop, args=(st,)).start()
+        '''})
+    found = LocksetRaceRule().run(idx)
+    assert any(f.symbol == 'State.total' for f in found), found
+    [f] = [f for f in found if f.symbol == 'State.total']
+    # the write is attributed to the spawned root THROUGH the call
+    # edge; the snapshot read stays on main
+    assert 'thread[loop]' in f.message and 'main' in f.message
+
+
+def test_lockset_race_factory_spawned_thread(tmp_path):
+    idx = make_index(tmp_path, {'fac.py': '''
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self.val = None
+
+            def make(self):
+                def worker():
+                    self.val = 1
+                return worker
+
+            def launch(self):
+                threading.Thread(target=self.make()).start()
+
+            def read(self):
+                return self.val
+    '''})
+    found = LocksetRaceRule().run(idx)
+    assert any(f.symbol == 'Holder.val' for f in found), found
+
+
+def test_lockset_race_write_before_spawn_is_published(tmp_path):
+    """start()-pattern: state reset ABOVE Thread.start() in the
+    spawning function happens-before the thread — no race."""
+    idx = make_index(tmp_path, {'wd.py': '''
+        import threading
+
+        class Dog:
+            def __init__(self):
+                self.beat_time = None
+
+            def start(self):
+                self.beat_time = 0.0
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                return self.beat_time
+    '''})
+    assert LocksetRaceRule().run(idx) == []
+
+
+def test_lockset_race_multi_instance_lost_update(tmp_path):
+    """Two instances of the SAME root (pool spawn in a loop) racing a
+    bare += — the server.py `requests` bug class."""
+    idx = make_index(tmp_path, {'srv.py': '''
+        import threading
+
+        class Server:
+            def __init__(self):
+                self.requests = 0
+
+            def serve(self):
+                while True:
+                    threading.Thread(target=self._handle).start()
+
+            def _handle(self):
+                self.requests += 1
+    '''})
+    found = LocksetRaceRule().run(idx)
+    assert any(f.symbol == 'Server.requests' for f in found), found
+
+
+def test_lockset_race_reports_every_racy_write_site(tmp_path):
+    """A suppression on ONE racy write must not swallow a DIFFERENT
+    unprotected write to the same attribute — one finding per write
+    site (code-review fix)."""
+    idx = make_index(tmp_path, {'two.py': '''
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.val = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                # lint: lockset-race-ok fixture: first write excused
+                self.val = 1
+
+            def other_write(self):
+                self.val = 2
+
+            def read(self):
+                return self.val
+    '''})
+    result = run_rules(idx, [LocksetRaceRule()])
+    assert len(result.suppressed) == 1
+    assert any(f.data['write']['symbol'] == 'Box.other_write'
+               for f in result.new), result.new
+
+
+def test_lockset_race_event_attr_exempt(tmp_path):
+    idx = make_index(tmp_path, {'ev.py': '''
+        import threading
+
+        class Loop:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def stop(self):
+                self._stop.set()
+
+            def restart(self):
+                self._stop = threading.Event()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    pass
+    '''})
+    assert LocksetRaceRule().run(idx) == []
+
+
+def test_lockset_race_generator_cm_releases_before_yield(tmp_path):
+    """A @contextmanager that acquires and RELEASES before its yield
+    (the replica `_fetching` shape) protects nothing — a write inside
+    its body is unprotected."""
+    idx = make_index(tmp_path, {'cm.py': '''
+        import contextlib
+        import threading
+
+        _lock = threading.Lock()
+
+        @contextlib.contextmanager
+        def counting():
+            with _lock:
+                pass
+            yield
+
+        class Box:
+            def __init__(self):
+                self.src = None
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with counting():
+                    self.src = 'thread'
+
+            def read(self):
+                return self.src
+    '''})
+    found = LocksetRaceRule().run(idx)
+    assert any(f.symbol == 'Box.src' for f in found), found
+
+
+def test_lockset_race_generator_cm_held_at_yield_protects(tmp_path):
+    idx = make_index(tmp_path, {'cm.py': '''
+        import contextlib
+        import threading
+
+        _lock = threading.Lock()
+
+        @contextlib.contextmanager
+        def locked():
+            with _lock:
+                yield
+
+        class Box:
+            def __init__(self):
+                self.src = None
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with locked():
+                    self.src = 'thread'
+
+            def read(self):
+                with locked():
+                    return self.src
+    '''})
+    assert LocksetRaceRule().run(idx) == []
+
+
+def test_lockset_race_module_global_tracked(tmp_path):
+    idx = make_index(tmp_path, {'glob.py': '''
+        import threading
+
+        _current = None
+
+        def publish(x):
+            global _current
+            _current = x
+
+        def read():
+            return _current
+
+        def launch():
+            threading.Thread(target=publish, args=(1,)).start()
+    '''})
+    found = LocksetRaceRule().run(idx)
+    assert any(f.symbol == '_current' for f in found), found
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock fixture matrix
+# ---------------------------------------------------------------------------
+
+BLOCKING_HOT_ROOTS = [('hot.py', 'dispatch')]
+
+
+def test_blocking_under_lock_no_timeout_flagged(tmp_path):
+    idx = make_index(tmp_path, {'hot.py': '''
+        import threading
+
+        _lock = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def slow(sock):
+            with _lock:
+                sock.recv(1024)
+
+        def joiner(t):
+            with _lock:
+                t.join()
+    '''})
+    found = BlockingUnderLockRule(hot_roots=BLOCKING_HOT_ROOTS,
+                                  blocking_callees=[]).run(idx)
+    msgs = [f.message for f in found]
+    assert any('.recv()' in m for m in msgs), msgs
+    assert any('Thread.join()' in m for m in msgs), msgs
+    assert all('dispatch' in m for m in msgs), msgs
+
+
+def test_blocking_under_lock_with_timeout_is_clean(tmp_path):
+    idx = make_index(tmp_path, {'hot.py': '''
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def bounded(t, q):
+            with _lock:
+                t.join(timeout=2.0)
+                q.get(timeout=1.0)
+                time.sleep(0.01)
+    '''})
+    assert BlockingUnderLockRule(hot_roots=BLOCKING_HOT_ROOTS,
+                                 blocking_callees=[]).run(idx) == []
+
+
+def test_blocking_under_lock_cold_lock_not_flagged(tmp_path):
+    """Blocking while holding a lock NO hot path touches is fine."""
+    idx = make_index(tmp_path, {'hot.py': '''
+        import threading
+
+        _lock = threading.Lock()
+        _cold = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def slow(sock):
+            with _cold:
+                sock.recv(1024)
+    '''})
+    assert BlockingUnderLockRule(hot_roots=BLOCKING_HOT_ROOTS,
+                                 blocking_callees=[]).run(idx) == []
+
+
+def test_blocking_under_lock_long_sleep_and_subprocess(tmp_path):
+    idx = make_index(tmp_path, {'hot.py': '''
+        import subprocess
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def sleeper():
+            with _lock:
+                time.sleep(5.0)
+
+        def shell():
+            with _lock:
+                subprocess.run(['true'])
+    '''})
+    found = BlockingUnderLockRule(hot_roots=BLOCKING_HOT_ROOTS,
+                                  blocking_callees=[]).run(idx)
+    msgs = [f.message for f in found]
+    assert any('time.sleep(5.0s)' in m for m in msgs), msgs
+    assert any('subprocess.run() without timeout=' in m
+               for m in msgs), msgs
+
+
+def test_blocking_under_lock_through_call_edge(tmp_path):
+    """The blocking call hides in a helper called under the lock."""
+    idx = make_index(tmp_path, {'hot.py': '''
+        import threading
+
+        _lock = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def helper(sock):
+            return sock.recv(4096)
+
+        def outer(sock):
+            with _lock:
+                helper(sock)
+    '''})
+    found = BlockingUnderLockRule(hot_roots=BLOCKING_HOT_ROOTS,
+                                  blocking_callees=[]).run(idx)
+    assert len(found) == 1, found
+    assert 'via call chain into helper' in found[0].message
+
+
+def test_blocking_under_lock_registered_callee(tmp_path):
+    idx = make_index(tmp_path, {'hot.py': '''
+        import threading
+
+        _lock = threading.Lock()
+
+        def dispatch():
+            with _lock:
+                pass
+
+        def opaque_blocker():
+            pass
+
+        def caller():
+            with _lock:
+                opaque_blocker()
+    '''})
+    rule = BlockingUnderLockRule(
+        hot_roots=BLOCKING_HOT_ROOTS,
+        blocking_callees=[('hot.py', 'opaque_blocker')])
+    found = rule.run(idx)
+    assert len(found) == 1, found
+    assert 'lint-registered as unboundedly blocking' in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# donation-lifetime fixture matrix
+# ---------------------------------------------------------------------------
+
+def test_donation_read_after_dispatch_flagged(tmp_path):
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0, 1))
+
+            def run(self, params, state, batch):
+                out = self._compiled(params, state, batch)
+                new_params, new_state = out
+                leaked = params['w'].addressable_shards
+                self._params = new_params
+                return leaked
+    '''})
+    found = DonationLifetimeRule().run(idx)
+    assert len(found) == 1, found
+    assert 'params' in found[0].message
+    assert 'addressable_shards' in found[0].message
+
+
+def test_donation_replaced_before_read_is_clean(tmp_path):
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0, 1))
+
+            def run(self, params, state, batch):
+                new_params, new_state = self._compiled(
+                    params, state, batch)
+                params = new_params
+                state = new_state
+                return params['w'].addressable_shards
+    '''})
+    assert DonationLifetimeRule().run(idx) == []
+
+
+def test_donation_self_attr_binding_tracked(tmp_path):
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0, 2))
+
+            def run(self, batch):
+                out = self._compiled(self._master, batch, self._state)
+                nbytes = device_nbytes(self._state)
+                self._master, self._state = out
+                return nbytes
+    '''})
+    found = DonationLifetimeRule().run(idx)
+    assert len(found) == 1, found
+    assert 'self._state' in found[0].message
+
+
+def test_donation_same_line_replace_is_clean(tmp_path):
+    """`self._p = self._compiled(self._p)` — the canonical single-line
+    rebind-from-outputs closes the donated window immediately
+    (code-review fix: the store on the dispatch line must count)."""
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0,))
+
+            def run(self, batch):
+                self._params = self._compiled(self._params)
+                return self._params
+    '''})
+    assert DonationLifetimeRule().run(idx) == []
+
+
+def test_donation_non_donated_position_is_clean(tmp_path):
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0,))
+
+            def run(self, params, batch):
+                out = self._compiled(params, batch)
+                size = batch.nbytes
+                params = out
+                return size
+    '''})
+    assert DonationLifetimeRule().run(idx) == []
+
+
+def test_donation_conditional_argnums_resolved(tmp_path):
+    """`donate = (0,) if flag else ()` — the union of the arms is
+    donated (either path must obey the rule)."""
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                donate = (0,) if self.donate else ()
+                self._compiled = jax.jit(fn, donate_argnums=donate)
+
+            def run(self, params, batch):
+                out = self._compiled(params, batch)
+                leaked = params.nbytes
+                params = out
+                return leaked
+    '''})
+    found = DonationLifetimeRule().run(idx)
+    assert len(found) == 1, found
+
+
+def test_donation_suppression(tmp_path):
+    idx = make_index(tmp_path, {'step.py': '''
+        import jax
+
+        class Step:
+            def build(self, fn):
+                self._compiled = jax.jit(fn, donate_argnums=(0,))
+
+            def run(self, params, batch):
+                out = self._compiled(params, batch)
+                # lint: donation-lifetime-ok debug path, program provably never reuses this buffer
+                leaked = params.nbytes
+                params = out
+                return leaked
+    '''})
+    result = run_rules(idx, [DonationLifetimeRule()])
+    assert result.new == []
+    assert len(result.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit + --format json + incremental cache
+# ---------------------------------------------------------------------------
+
+def test_stale_suppression_detected(tmp_path):
+    idx = make_index(tmp_path, {'mod.py': '''
+        import os
+        x = 1  # lint: knob-drift-ok nothing here triggers the rule anymore
+        y = os.environ.get('MXTPU_LIVE_FLAG')  # lint: knob-drift-ok used marker
+    '''})
+    from mxtpu_lint.rules.knobs import KnobDriftRule
+    result = run_rules(idx, [KnobDriftRule(readme_text='')])
+    assert len(result.suppressed) == 1
+    assert len(result.stale_suppressions) == 1
+    rel, line, rule, reason = result.stale_suppressions[0]
+    assert rule == 'knob-drift' and 'anymore' in reason
+
+
+def test_stale_suppression_other_rules_not_audited(tmp_path):
+    """A marker for a rule that DID NOT RUN is not stale — the audit
+    only judges rules it executed."""
+    idx = make_index(tmp_path, {'mod.py': '''
+        x = 1  # lint: host-sync-ok not judged when only knob-drift runs
+    '''})
+    from mxtpu_lint.rules.knobs import KnobDriftRule
+    result = run_rules(idx, [KnobDriftRule(readme_text='')])
+    assert result.stale_suppressions == []
+
+
+def test_cli_stale_suppressions_exit_code(tmp_path):
+    pkg = tmp_path / 'stalepkg'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    (pkg / 'mod.py').write_text(
+        'x = 1  # lint: knob-drift-ok long gone\n')
+    res = subprocess.run(
+        [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline', 'none',
+         '--no-cache', '--stale-suppressions', str(pkg)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert 'stale-suppression' in res.stderr
+    # without the flag the same tree passes
+    res = subprocess.run(
+        [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline', 'none',
+         '--no-cache', str(pkg)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_repo_has_no_stale_suppressions():
+    """The sweep the issue asks for, kept green: every `# lint: *-ok`
+    marker in the shipped tree still silences a live finding."""
+    res = subprocess.run(
+        [sys.executable, '-m', 'tools.mxtpu_lint', '--no-cache',
+         '--stale-suppressions'],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_format_json(tmp_path):
+    pkg = tmp_path / 'jsonpkg'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    (pkg / 'mod.py').write_text(textwrap.dedent('''
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.n += 1
+
+            def read(self):
+                return self.n
+    '''))
+    res = subprocess.run(
+        [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline', 'none',
+         '--no-cache', '--format', 'json', str(pkg)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc['clean'] is False
+    [f] = [f for f in doc['findings'] if f['rule'] == 'lockset-race']
+    assert f['symbol'] == 'Box.n'
+    assert f['severity'] == 'error'
+    assert f['path'].endswith('mod.py') and f['line'] > 0
+    assert len(f['fingerprint']) == 16
+    # the thread-root annotation rides in data
+    assert any('thread:' in r for r in f['data']['write']['thread_roots'])
+    assert doc['stats']['files'] >= 2
+
+
+def test_incremental_cache_hit_and_invalidation(tmp_path):
+    pkg = tmp_path / 'cachepkg'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    mod = pkg / 'mod.py'
+    mod.write_text("import os\nx = os.environ.get('MXTPU_CACHED')\n")
+    env = dict(os.environ, MXTPU_LINT_TEST='1')
+    args = [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline',
+            'none', str(pkg)]
+    first = subprocess.run(args, cwd=REPO, capture_output=True,
+                           text=True, timeout=300, env=env)
+    assert first.returncode == 1
+    assert 'cache hit' not in first.stdout
+    second = subprocess.run(args, cwd=REPO, capture_output=True,
+                            text=True, timeout=300, env=env)
+    assert second.returncode == 1, second.stdout + second.stderr
+    assert 'cache hit' in second.stdout
+    assert 'MXTPU_CACHED' in second.stderr      # replayed finding
+    # an edit invalidates (mtime+size key)
+    time.sleep(0.01)
+    mod.write_text("import os\ny = os.environ.get('MXTPU_CHANGED_X')\n")
+    third = subprocess.run(args, cwd=REPO, capture_output=True,
+                           text=True, timeout=300, env=env)
+    assert third.returncode == 1
+    assert 'cache hit' not in third.stdout
+    assert 'MXTPU_CHANGED_X' in third.stderr
+
+
+def test_cache_slots_per_rule_set(tmp_path):
+    """Alternating --rules sets must not evict each other's slot
+    (code-review fix: one cache file per rule set)."""
+    pkg = tmp_path / 'slotpkg'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    (pkg / 'mod.py').write_text('x = 1\n')
+    base = [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline',
+            'none', str(pkg)]
+    subprocess.run(base, cwd=REPO, capture_output=True, text=True,
+                   timeout=300)                     # full set: store
+    subprocess.run(base + ['--rules', 'knob-drift'], cwd=REPO,
+                   capture_output=True, text=True, timeout=300)
+    full = subprocess.run(base, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+    assert 'cache hit' in full.stdout, full.stdout   # not evicted
+    sub = subprocess.run(base + ['--rules', 'knob-drift'], cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    assert 'cache hit' in sub.stdout, sub.stdout
+
+
+def test_cache_replay_respects_new_suppression(tmp_path):
+    """A suppression comment edit must take effect on a WARM run —
+    the filter re-runs live even when findings replay from cache."""
+    pkg = tmp_path / 'suppkg'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    mod = pkg / 'mod.py'
+    mod.write_text("import os\nx = os.environ.get('MXTPU_TOSUPP')\n")
+    args = [sys.executable, '-m', 'tools.mxtpu_lint', '--baseline',
+            'none', str(pkg)]
+    first = subprocess.run(args, cwd=REPO, capture_output=True,
+                           text=True, timeout=300)
+    assert first.returncode == 1
+    mod.write_text("import os\nx = os.environ.get('MXTPU_TOSUPP')"
+                   "  # lint: knob-drift-ok fixture reason\n")
+    second = subprocess.run(args, cwd=REPO, capture_output=True,
+                            text=True, timeout=300)
+    assert second.returncode == 0, second.stdout + second.stderr
+
+
+def test_finding_json_roundtrip(tmp_path):
+    idx = make_index(tmp_path, {'box.py': WORKER_SRC})
+    [f] = LocksetRaceRule().run(idx)
+    doc = f.to_json()
+    from mxtpu_lint.core import Finding
+    back = Finding.from_json(doc, idx)
+    assert back.fingerprint == f.fingerprint
+    assert back.data == f.data
+    assert back.line == f.line
+
+
+# ---------------------------------------------------------------------------
+# the repo gate for the new rules + determinism
+# ---------------------------------------------------------------------------
+
+def test_repo_clean_under_new_rules():
+    res = subprocess.run(
+        [sys.executable, '-m', 'tools.mxtpu_lint', '--no-cache',
+         '--rules', 'lockset-race,blocking-under-lock,donation-lifetime',
+         '--baseline', 'none'],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_lockset_analyzer_deterministic_3x():
+    """tools/flakiness_checker.py 3x over the lockset tests: thread
+    roots, locksets and race pairing are pure functions of the
+    source — set/hash ordering must never leak into findings."""
+    tools = os.path.join(REPO, 'tools', 'flakiness_checker.py')
+    for test in ('test_lockset_race_true_race_detected',
+                 'test_lockset_race_cross_module_via_call_edge'):
+        res = subprocess.run(
+            [sys.executable, tools,
+             f'tests/test_lint_threads.py::{test}', '-n', '3'],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert '3/3 passed' in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the real defects the new rules surfaced at HEAD
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_instance_lock_reentrant():
+    """FlightRecorder._lock must be reentrant: note() runs inside the
+    SIGTERM preemption save — a signal landing while THIS thread is in
+    record_step's critical section re-enters (found by signal-safety
+    once the call graph resolved `get().note(...)`)."""
+    from mxnet_tpu.telemetry.flight import FlightRecorder
+    rec = FlightRecorder(capacity=4)
+    assert rec._lock.acquire(blocking=False)
+    try:
+        got = rec._lock.acquire(blocking=False)
+        assert got, ('FlightRecorder._lock is not reentrant — a signal '
+                     'interrupting its critical section self-deadlocks')
+        rec._lock.release()
+    finally:
+        rec._lock.release()
+
+
+def test_telemetry_server_request_counter_no_lost_updates():
+    """Concurrent scrapes must not lose `requests` increments (the
+    bare `+= 1` from pool handler threads the lockset rule flagged)."""
+    from mxnet_tpu.telemetry import server as tserver
+    srv = tserver.TelemetryServer(port=0, max_handlers=4)
+    try:
+        import urllib.request
+        n = 12
+        errs = []
+
+        def scrape():
+            try:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{srv.port}/metrics',
+                    timeout=10).read()
+            except Exception as e:       # capacity shedding: retry once
+                try:
+                    time.sleep(0.05)
+                    urllib.request.urlopen(
+                        f'http://127.0.0.1:{srv.port}/metrics',
+                        timeout=10).read()
+                except Exception:
+                    errs.append(e)
+
+        threads = [threading.Thread(target=scrape) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        deadline = time.monotonic() + 5
+        while srv.requests < n - len(errs) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.requests >= n - len(errs), (srv.requests, n, errs)
+    finally:
+        srv.stop()
+
+
+def test_telemetry_server_stop_start_cycle():
+    """stop() retires the socket under the lock; a restart binds a
+    fresh one (the stop-vs-accept teardown race the rule flagged)."""
+    from mxnet_tpu.telemetry import server as tserver
+    srv = tserver.TelemetryServer(port=0)
+    port1 = srv.port
+    srv.stop()
+    assert srv._server is None
+    srv.port = 0
+    srv.start()
+    try:
+        assert srv._server is not None
+        import urllib.request
+        body = urllib.request.urlopen(
+            f'http://127.0.0.1:{srv.port}/healthz', timeout=10).read()
+        assert body
+    finally:
+        srv.stop()
+    assert port1 > 0
+
+
+def test_replica_restore_source_accessor(tmp_path):
+    """repair_step/_fetch_step return the source; restore_source()
+    reads the attribute under the queue lock (the scrubber-vs-restore
+    write race the rule flagged)."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.checkpoint.replica import ReplicaManager
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'), async_save=False,
+                            replication=False)
+    rm = ReplicaManager(mgr, rank=0, peers=[], replicas=0, serve=False,
+                        scrub_seconds=0, resync=False)
+    mgr.attach_replication(rm)
+    try:
+        assert rm.restore_source() is None
+        with rm._cond:
+            rm.last_restore_source = 'hosted:rank1'
+        assert rm.restore_source() == 'hosted:rank1'
+        assert mgr.last_restore_source == 'hosted:rank1'
+    finally:
+        rm.close()
+        mgr.close()
+
+
+def test_watchdog_save_thread_reads_last_step_under_lock(tmp_path):
+    """_try_save falls back to beat()'s last_step through the
+    watchdog lock (the cross-thread read the rule flagged)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.resilience.watchdog import StepWatchdog
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'), async_save=False,
+                            replication=False,
+                            params={'w': mx.nd.array([2.0])})
+    wd = StepWatchdog(deadline_seconds=30, manager=mgr)
+    wd.beat(7)
+    wd._try_save()
+    mgr.wait()
+    assert mgr.latest_step() == 7
+    mgr.close()
+
+
+def test_elastic_suspected_set_is_lock_guarded():
+    from mxnet_tpu.resilience.elastic import ElasticController
+    ec = ElasticController.__new__(ElasticController)
+    ec._suspected = set()
+    ec._suspected_lock = threading.Lock()
+    with ec._suspected_lock:
+        ec._suspected.add(3)
+    assert 3 in ec._suspected
+
+
+def test_membership_request_snapshots_endpoint_under_lock():
+    """retarget() swaps (host, port) as a pair under the lock;
+    _request reads them as a pair under the same lock — a beat racing
+    a retarget connects to old-host:old-port or new:new, never a
+    cross-generation mix."""
+    from mxnet_tpu.parallel.dist import Membership
+    ms = Membership(rank=1, world=2, start=False,
+                    coordinator_host='127.0.0.1', port=1)
+    ms.retarget(host='10.0.0.9', port=2345)
+    with ms._lock:
+        assert (ms.coordinator_host, ms.port) == ('10.0.0.9', 2345)
+
+
+def test_membership_global_publication_locked():
+    from mxnet_tpu.parallel import dist as _dist
+    # the accessor reads through the publication lock (RLock: also on
+    # the SIGTERM path) — reentrancy must hold
+    assert _dist._membership_lock.acquire(blocking=False)
+    try:
+        assert _dist._membership_lock.acquire(blocking=False)
+        _dist._membership_lock.release()
+        assert _dist.membership() is None or \
+            _dist.membership() is not None       # no deadlock
+    finally:
+        _dist._membership_lock.release()

@@ -1,15 +1,18 @@
 """CLI: ``python -m tools.mxtpu_lint [options] [PKG_DIR]``.
 
 Exit codes: 0 clean (no new findings — suppressed and baselined ones
-are reported informationally), 1 new findings, 2 usage/parse errors.
+are reported informationally), 1 new findings (or stale suppressions
+under ``--stale-suppressions``), 2 usage/parse errors.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
 
+from . import cache as _cache
 from .core import Baseline, FileIndex, run_rules
 from .rules import ALL_RULES, rules_by_id
 
@@ -31,6 +34,18 @@ def main(argv=None):
     ap.add_argument('--write-baseline', action='store_true',
                     help='grandfather every current new finding into '
                          'the baseline file and exit 0')
+    ap.add_argument('--stale-suppressions', action='store_true',
+                    help='also FAIL (exit 1) on `# lint: <rule>-ok` '
+                         'comments whose line no longer triggers their '
+                         'rule — the audit CI runs so dead markers '
+                         'cannot silently re-arm')
+    ap.add_argument('--format', choices=('text', 'json'), default='text',
+                    help='json: machine-readable findings (rule, '
+                         'severity, file:line, symbol, thread roots, '
+                         'fingerprint) on stdout')
+    ap.add_argument('--no-cache', action='store_true',
+                    help='bypass the mtime+size-keyed result cache '
+                         'under .mxtpu_lint_cache/')
     ap.add_argument('--list-rules', action='store_true')
     ap.add_argument('-q', '--quiet', action='store_true',
                     help='violations only (no summary line)')
@@ -38,7 +53,7 @@ def main(argv=None):
 
     if args.list_rules:
         for r in ALL_RULES:
-            print(f'{r.id:16} {r.doc}')
+            print(f'{r.id:18} {r.doc}')
         return 0
 
     pkg = args.pkg_dir or os.path.join(
@@ -48,6 +63,7 @@ def main(argv=None):
         return 2
 
     rules = rules_by_id(args.rules.split(',') if args.rules else None)
+    rule_ids = [r.id for r in rules]
     baseline = Baseline() if args.baseline == 'none' else \
         Baseline.load(args.baseline)
 
@@ -59,7 +75,11 @@ def main(argv=None):
     if index.errors:
         return 2
 
-    result = run_rules(index, rules, baseline)
+    raw = None if args.no_cache else _cache.load(index, rule_ids)
+    cache_hit = raw is not None
+    result = run_rules(index, rules, baseline, raw=raw)
+    if not args.no_cache and not cache_hit:
+        _cache.store(index, rule_ids, result.raw)
     t_total = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -72,8 +92,41 @@ def main(argv=None):
               f'({len(baseline.entries)} total) to {args.baseline}')
         return 0
 
+    stale_supp = result.stale_suppressions if args.stale_suppressions \
+        else []
+    failed = bool(result.errors) or bool(stale_supp)
+
+    if args.format == 'json':
+        doc = {
+            'version': 1,
+            'clean': not failed,
+            'cache': 'hit' if cache_hit else
+                     ('bypassed' if args.no_cache else 'miss'),
+            'findings': [f.to_json() for f in result.new],
+            'suppressed': [{**f.to_json(), 'reason': reason}
+                           for f, reason in result.suppressed],
+            'baselined': [f.to_json() for f in result.baselined],
+            'stale_baseline_entries': result.stale,
+            'stale_suppressions': [
+                {'path': rel, 'line': line, 'rule': rule,
+                 'reason': reason}
+                for rel, line, rule, reason in result.stale_suppressions],
+            'stats': {'files': len(index.files),
+                      'functions': len(index.functions),
+                      'rules': rule_ids,
+                      'parse_ms': round(t_parse * 1e3, 1),
+                      'total_ms': round(t_total * 1e3, 1)},
+        }
+        print(json.dumps(doc, indent=2))
+        return 1 if failed else 0
+
     for f in result.new:
         print(f.format(), file=sys.stderr)
+    for rel, line, rule, reason in stale_supp:
+        print(f"{rel}:{line}: [stale-suppression] `# lint: {rule}-ok "
+              f"{reason}` no longer silences anything — the code it "
+              f"excused changed; remove the marker (or fix what "
+              f"regressed)", file=sys.stderr)
     if not args.quiet:
         for fp in result.stale:
             ent = baseline.entries[fp]
@@ -87,8 +140,9 @@ def main(argv=None):
               f"{len(result.suppressed)} suppressed in-place over "
               f"{n_files} files / {n_funcs} functions "
               f"[{len(rules)} rules, parse {t_parse * 1e3:.0f} ms, "
-              f"total {t_total * 1e3:.0f} ms]")
-    return 1 if result.errors else 0
+              f"total {t_total * 1e3:.0f} ms"
+              f"{', cache hit' if cache_hit else ''}]")
+    return 1 if failed else 0
 
 
 if __name__ == '__main__':

@@ -1,0 +1,122 @@
+"""Incremental lint cache: mtime+size-keyed replay of rule findings.
+
+The three whole-program rules (lockset-race, blocking-under-lock,
+donation-lifetime) push a cold full-repo run toward the PERF_NOTES
+budget; CI and pre-commit hooks re-run the linter far more often than
+the tree changes. The cache keys the RAW per-rule findings on a
+fingerprint of every linted file's ``(relpath, mtime_ns, size)``
+vector, the rule ids, and the lint tool's own source stats (an
+analyzer edit invalidates everything — a cache that survives rule
+changes would replay yesterday's judgment). On a hit, findings replay
+from JSON and only the suppression/baseline FILTER re-runs live, so a
+comment or baseline edit never needs a cold pass.
+
+Whole-tree keying (not per-file) is deliberate: the new rules are
+whole-program analyses — one edited file can change the thread roots,
+locksets or call edges of every other file, so per-file result reuse
+would be unsound. Per-file reuse of the PARSE is what the shared
+``FileIndex`` already gives a single run; across runs, parse is ~0.8 s
+of a ~3 s cold pass while the rules are the rest — replaying rule
+output is where the time is.
+
+``--no-cache`` bypasses reads and writes.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from .core import FileIndex, Finding
+
+CACHE_VERSION = 1
+CACHE_DIRNAME = '.mxtpu_lint_cache'
+
+
+def _tool_stats() -> List:
+    """(relpath, mtime_ns, size) for the lint tool's own sources —
+    part of the key so editing a rule invalidates cached findings."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for dirpath, dirnames, filenames in os.walk(here):
+        dirnames[:] = sorted(d for d in dirnames if d != '__pycache__'
+                             and d != CACHE_DIRNAME)
+        for fname in sorted(filenames):
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append((os.path.relpath(path, here),
+                        st.st_mtime_ns, st.st_size))
+    return out
+
+
+def cache_key(index: FileIndex, rule_ids) -> str:
+    doc = {'version': CACHE_VERSION,
+           'pkg': index.pkg_dir,
+           'rules': sorted(rule_ids),
+           'files': index.file_stats,
+           'tool': _tool_stats()}
+    raw = json.dumps(doc, sort_keys=True).encode()
+    return hashlib.sha256(raw).hexdigest()[:32]
+
+
+def cache_dir(index: FileIndex) -> str:
+    return os.path.join(index.root, CACHE_DIRNAME)
+
+
+def _cache_path(index: FileIndex, rule_ids) -> str:
+    """One slot PER RULE SET: a developer iterating with `--rules
+    lockset-race` must not evict the full-run slot the pre-commit hook
+    hits (and vice versa) — alternating rule sets would otherwise pay
+    a cold whole-program pass every time."""
+    tag = hashlib.sha256(
+        ','.join(sorted(rule_ids)).encode()).hexdigest()[:12]
+    return os.path.join(cache_dir(index), f'findings-{tag}.json')
+
+
+def load(index: FileIndex, rule_ids) -> Optional[Dict[str, List[Finding]]]:
+    """{rule id: [Finding]} replayed from a cache hit, else None."""
+    path = _cache_path(index, rule_ids)
+    try:
+        with open(path, encoding='utf-8') as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get('key') != cache_key(index, rule_ids):
+        return None
+    cached = doc.get('findings', {})
+    if not all(rid in cached for rid in rule_ids):
+        return None
+    out: Dict[str, List[Finding]] = {}
+    try:
+        for rid in rule_ids:
+            out[rid] = [Finding.from_json(ent, index)
+                        for ent in cached[rid]]
+    except (KeyError, TypeError):
+        return None
+    return out
+
+
+def store(index: FileIndex, rule_ids,
+          raw: Dict[str, List[Finding]]) -> bool:
+    d = cache_dir(index)
+    path = _cache_path(index, rule_ids)
+    try:
+        os.makedirs(d, exist_ok=True)
+        doc = {'key': cache_key(index, rule_ids),
+               'comment': 'mxtpu_lint incremental result cache — '
+                          'safe to delete; --no-cache bypasses',
+               'findings': {rid: [f.to_json() for f in raw.get(rid, [])]
+                            for rid in rule_ids}}
+        tmp = path + f'.tmp-{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        return False
+    return True

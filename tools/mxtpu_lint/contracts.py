@@ -310,3 +310,39 @@ HOT_PATH_FILES = (
     'telemetry/flight.py',
     'io/io.py',
 )
+
+# ---------------------------------------------------------------------------
+# hot-lock roots (blocking-under-lock rule): paths that must never wait
+# on a contended lock for long — the step-dispatch cone (the same roots
+# the host-sync rule measures from) plus the latency-sensitive service
+# loops: heartbeat handling (a blocked beat reads as a PEER LOSS to the
+# whole fleet) and the metrics/health scrape path (a blocked handler
+# slot is how the PR 12 slow-loris class started). A lock acquired
+# anywhere in these cones is a HOT lock; blocking unboundedly while
+# holding one stalls the hot path for the duration.
+# ---------------------------------------------------------------------------
+
+HOT_LOCK_ROOTS = HOT_PATH_ROOTS + [
+    # membership heartbeat send + coordinator-side beat handling
+    ('parallel/dist.py', 'Membership._beat_loop'),
+    ('parallel/dist.py', 'Membership._handle_locked'),
+    # metric scrape / health endpoint handler path
+    ('telemetry/server.py', 'TelemetryServer._handle_conn'),
+    ('telemetry/server.py', 'TelemetryServer._route'),
+]
+
+# ---------------------------------------------------------------------------
+# lint-registered blocking callees (blocking-under-lock rule): functions
+# KNOWN to block unboundedly that the syntactic predicate cannot see
+# (the blocking primitive hides behind a C extension or a retry loop
+# with no overall deadline). Calling one of these while holding a hot
+# lock is a finding even though the call site looks innocent.
+# (relpath suffix, qualname glob) — same shape as the root tables.
+# ---------------------------------------------------------------------------
+
+BLOCKING_CALLEES = [
+    # jax.distributed client construction blocks until the coordinator
+    # answers (dist.init wraps it in bounded retries, but the CALL has
+    # no deadline of its own)
+    ('parallel/dist.py', '_initialize_once'),
+]

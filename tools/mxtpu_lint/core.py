@@ -36,7 +36,8 @@ class Finding:
     """
 
     def __init__(self, rule: str, file: 'SourceFile', line: int,
-                 message: str, symbol: str = '', severity: str = 'error'):
+                 message: str, symbol: str = '', severity: str = 'error',
+                 data: Optional[dict] = None):
         self.rule = rule
         self.file = file
         self.relpath = file.relpath if file is not None else '<project>'
@@ -44,6 +45,8 @@ class Finding:
         self.message = message
         self.symbol = symbol
         self.severity = severity         # 'error' fails CI; 'warning' reports
+        self.data = data or {}           # structured extras (--format json):
+        #                                  thread roots, lock keys, ...
 
     @property
     def fingerprint(self) -> str:
@@ -57,6 +60,24 @@ class Finding:
         return (f"{self.relpath}:{self.line}: [{self.rule}]{sev}{sym} "
                 f"{self.message}")
 
+    def to_json(self) -> dict:
+        """Machine-readable form (--format json / the result cache)."""
+        out = {'rule': self.rule, 'severity': self.severity,
+               'path': self.relpath, 'line': self.line,
+               'symbol': self.symbol, 'message': self.message,
+               'fingerprint': self.fingerprint}
+        if self.data:
+            out['data'] = self.data
+        return out
+
+    @classmethod
+    def from_json(cls, doc: dict, index: 'FileIndex') -> 'Finding':
+        """Rebind a cached finding onto the live index (replay path)."""
+        return cls(doc['rule'], index.file(doc['path']), doc['line'],
+                   doc['message'], symbol=doc.get('symbol', ''),
+                   severity=doc.get('severity', 'error'),
+                   data=doc.get('data'))
+
     def __repr__(self):
         return f"Finding({self.format()!r})"
 
@@ -65,7 +86,7 @@ class FuncInfo:
     """One function/method definition in the tree."""
 
     __slots__ = ('file', 'node', 'name', 'qualname', 'cls', 'parent',
-                 'nested')
+                 'nested', '_body_nodes')
 
     def __init__(self, file, node, qualname, cls=None, parent=None):
         self.file = file
@@ -75,6 +96,7 @@ class FuncInfo:
         self.cls = cls                   # enclosing class name or None
         self.parent = parent             # enclosing FuncInfo or None
         self.nested: List['FuncInfo'] = []
+        self._body_nodes = None          # walk_function cache
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -93,8 +115,17 @@ class SourceFile:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
+        self._nodes = None               # cached ast.walk list
         self.suppressions = self._parse_suppressions()
         self.imports = self._parse_imports()
+
+    def walk(self) -> List[ast.AST]:
+        """Every node of this file's tree, cached: each rule used to
+        re-run ``ast.walk`` over every file, which dominated the lint
+        wall time once the whole-program rules multiplied the passes."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     # -- suppression comments ---------------------------------------------
     #
@@ -107,6 +138,11 @@ class SourceFile:
 
     def _parse_suppressions(self) -> Dict[int, Dict[str, str]]:
         out: Dict[int, Dict[str, str]] = {}
+        # every suppression COMMENT (one per written marker, keyed by
+        # the comment's own line) — the stale-suppression audit walks
+        # these; `suppressions` above maps COVERED lines, so a
+        # comment-only marker appears there twice
+        self.suppression_comments: List[Tuple[int, str, str]] = []
         for i, line in enumerate(self.lines, start=1):
             m = SUPPRESS_RE.search(line)
             if not m:
@@ -114,13 +150,21 @@ class SourceFile:
             rule, reason = m.group(1), m.group(2).strip()
             if not reason:
                 continue                  # reasonless: not a suppression
-            out.setdefault(i, {})[rule] = reason
+            self.suppression_comments.append((i, rule, reason))
+            out.setdefault(i, {})[rule] = (reason, i)
             if line.lstrip().startswith('#'):
-                out.setdefault(i + 1, {})[rule] = reason
+                out.setdefault(i + 1, {})[rule] = (reason, i)
         return out
 
     def suppressed(self, rule: str, line: int) -> Optional[str]:
         """The suppression reason covering (rule, line), or None."""
+        got = self.suppression_at(rule, line)
+        return got[0] if got else None
+
+    def suppression_at(self, rule: str, line: int
+                       ) -> Optional[Tuple[str, int]]:
+        """(reason, comment line) covering (rule, line), or None —
+        the comment line is what the stale-suppression audit keys on."""
         ent = self.suppressions.get(line)
         if ent and rule in ent:
             return ent[rule]
@@ -138,7 +182,7 @@ class SourceFile:
         pkg_parts = self.relpath.split('/')[:-1]   # e.g. mxnet_tpu/parallel
         out: Dict[str, str] = {}
         self.star_imports: List[str] = []
-        for node in ast.walk(self.tree):
+        for node in self.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     out[a.asname or a.name.split('.')[0]] = a.name
@@ -157,6 +201,22 @@ class SourceFile:
                     out[a.asname or a.name] = (mod + '.' + a.name
                                                if mod else a.name)
         return out
+
+
+# method names every stdlib file / socket / container / thread object
+# answers to — excluded from the unique-method call-graph fallback (a
+# call through an opaque receiver must not resolve to the one
+# user-defined method sharing such a generic name)
+_UBIQUITOUS_METHODS = frozenset({
+    'read', 'write', 'readline', 'readlines', 'tell', 'seek', 'flush',
+    'open', 'close', 'send', 'sendall', 'recv', 'accept', 'connect',
+    'get', 'put', 'pop', 'append', 'extend', 'add', 'remove', 'clear',
+    'update', 'copy', 'keys', 'values', 'items', 'join', 'split',
+    'strip', 'encode', 'decode', 'format', 'count', 'index', 'sort',
+    'reverse', 'setdefault', 'acquire', 'release', 'wait', 'notify',
+    'set', 'start', 'cancel', 'fileno', 'settimeout', 'bind', 'listen',
+    'run', 'next',
+})
 
 
 class FileIndex:
@@ -183,6 +243,7 @@ class FileIndex:
     # -- loading -----------------------------------------------------------
 
     def _load(self):
+        self.file_stats: List[Tuple[str, int, int]] = []
         for dirpath, dirnames, filenames in os.walk(self.pkg_dir):
             dirnames[:] = sorted(d for d in dirnames
                                  if d != '__pycache__')
@@ -193,12 +254,17 @@ class FileIndex:
                 relpath = os.path.relpath(path, self.root).replace(
                     os.sep, '/')
                 try:
+                    st = os.stat(path)
                     with open(path, encoding='utf-8') as f:
                         text = f.read()
                     sf = SourceFile(path, relpath, text)
                 except (SyntaxError, UnicodeDecodeError, OSError) as e:
                     self.errors.append((path, str(e)))
                     continue
+                # (relpath, mtime_ns, size): the incremental cache's
+                # change-detection vector
+                self.file_stats.append(
+                    (relpath, st.st_mtime_ns, st.st_size))
                 self.files.append(sf)
                 self._by_relpath[relpath] = sf
 
@@ -322,12 +388,21 @@ class FileIndex:
                 dotted = sf.imports.get(val.id)
                 if dotted:
                     return self._resolve_dotted(f'{dotted}.{attr}')
-            # unknown receiver: accept a method name defined exactly
+            # unknown receiver: accept a METHOD name defined exactly
             # once in the whole tree (unique is unambiguous; anything
-            # else would be guessing)
-            hits = self.methods_named(attr)
-            if len(hits) == 1:
-                return hits
+            # else would be guessing). Module-level functions are
+            # excluded — `client.shutdown()` on an opaque receiver must
+            # not resolve to a free function that happens to share the
+            # name (module functions are reached via their import
+            # binding, which the Name branch above already handles) —
+            # and so are names every stdlib file/socket/container
+            # answers to: `f.tell()` on a file handle must not grow an
+            # edge to MXRecordIO.tell just because that is the one
+            # user-defined `tell` in the tree
+            if attr not in _UBIQUITOUS_METHODS:
+                hits = [m for m in self.methods_named(attr) if m.cls]
+                if len(hits) == 1:
+                    return hits
         return []
 
     def _resolve_dotted(self, dotted: str,
@@ -410,17 +485,23 @@ class FileIndex:
                         out.append(fi)
         return out
 
-    def walk_function(self, fi: FuncInfo) -> Iterable[ast.AST]:
-        """Walk a function body EXCLUDING nested function bodies (those
-        belong to their own FuncInfo)."""
+    def walk_function(self, fi: FuncInfo) -> List[ast.AST]:
+        """Nodes of a function body EXCLUDING nested function bodies
+        (those belong to their own FuncInfo). Cached per function —
+        every reachability rule re-walks the same bodies."""
+        if fi._body_nodes is not None:
+            return fi._body_nodes
         nested_nodes = {id(n.node) for n in fi.nested}
+        out = []
         stack = list(ast.iter_child_nodes(fi.node))
         while stack:
             node = stack.pop()
             if id(node) in nested_nodes:
                 continue
-            yield node
+            out.append(node)
             stack.extend(ast.iter_child_nodes(node))
+        fi._body_nodes = out
+        return out
 
     def reachable(self, roots: Iterable[Tuple[str, str]],
                   max_depth: Optional[int] = None
@@ -456,9 +537,9 @@ class LintRule:
         raise NotImplementedError
 
     def finding(self, file, line, message, symbol='',
-                severity=None) -> Finding:
+                severity=None, data=None) -> Finding:
         return Finding(self.id, file, line, message, symbol=symbol,
-                       severity=severity or self.severity)
+                       severity=severity or self.severity, data=data)
 
 
 class Baseline:
@@ -504,11 +585,16 @@ class Baseline:
 
 
 class LintResult:
-    def __init__(self, new, suppressed, baselined, stale):
+    def __init__(self, new, suppressed, baselined, stale,
+                 stale_suppressions=None, raw=None):
         self.new = new                   # [Finding] — these fail CI
         self.suppressed = suppressed     # [(Finding, reason)]
         self.baselined = baselined       # [Finding]
         self.stale = stale               # [fingerprint] unused entries
+        # [(relpath, comment line, rule, reason)] — suppression comments
+        # whose line no longer triggers their rule (--stale-suppressions)
+        self.stale_suppressions = stale_suppressions or []
+        self.raw = raw or {}             # {rule id: [Finding]} pre-filter
 
     @property
     def errors(self):
@@ -519,16 +605,28 @@ class LintResult:
         return not self.errors
 
 
-def run_rules(index: FileIndex, rules, baseline: Optional[Baseline] = None
+def run_rules(index: FileIndex, rules,
+              baseline: Optional[Baseline] = None,
+              raw: Optional[Dict[str, List[Finding]]] = None
               ) -> LintResult:
+    """Run (or, given ``raw`` — the incremental cache's replay path —
+    re-filter) the rules. Suppression and baseline filtering always
+    happen live so a baseline/comment edit never needs a cold run."""
     baseline = baseline or Baseline()
     new, suppressed, baselined = [], [], []
     seen_fps = set()
+    used_comments = set()       # (relpath, comment line, rule)
+    raw_out: Dict[str, List[Finding]] = {}
     for rule in rules:
-        for f in rule.run(index):
-            reason = (f.file.suppressed(rule.id, f.line)
-                      if f.file is not None else None)
-            if reason is not None:
+        produced = raw[rule.id] if raw is not None and rule.id in raw \
+            else rule.run(index)
+        raw_out[rule.id] = produced
+        for f in produced:
+            ent = (f.file.suppression_at(rule.id, f.line)
+                   if f.file is not None else None)
+            if ent is not None:
+                reason, comment_line = ent
+                used_comments.add((f.relpath, comment_line, rule.id))
                 suppressed.append((f, reason))
             elif baseline.covers(f):
                 baselined.append(f)
@@ -536,8 +634,19 @@ def run_rules(index: FileIndex, rules, baseline: Optional[Baseline] = None
             else:
                 new.append(f)
     stale = [fp for fp in baseline.entries if fp not in seen_fps]
+    # suppression comments for a rule we ran that silenced nothing this
+    # run are stale: the code they excused changed (or the rule did) —
+    # an unaccountable marker would silently re-arm if the bug returned
+    ran_ids = {r.id for r in rules}
+    stale_supp = []
+    for sf in index.files:
+        for line, rule_id, reason in sf.suppression_comments:
+            if rule_id in ran_ids and \
+                    (sf.relpath, line, rule_id) not in used_comments:
+                stale_supp.append((sf.relpath, line, rule_id, reason))
     new.sort(key=lambda f: (f.relpath, f.line, f.rule))
-    return LintResult(new, suppressed, baselined, stale)
+    return LintResult(new, suppressed, baselined, stale,
+                      stale_suppressions=sorted(stale_supp), raw=raw_out)
 
 
 # -- small AST helpers shared by the rules ----------------------------------

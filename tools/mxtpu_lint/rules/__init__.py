@@ -1,8 +1,10 @@
 """Rule registry: one instance of every shipped rule."""
+from .donation import DonationLifetimeRule
 from .host_sync import HostSyncRule
 from .jit_purity import JitPurityRule
 from .knobs import KnobDriftRule
 from .locks import LockOrderRule, SignalSafetyRule
+from .races import BlockingUnderLockRule, LocksetRaceRule
 from .registry_drift import RegistryDriftRule
 
 ALL_RULES = [
@@ -10,6 +12,9 @@ ALL_RULES = [
     JitPurityRule(),
     LockOrderRule(),
     SignalSafetyRule(),
+    LocksetRaceRule(),
+    BlockingUnderLockRule(),
+    DonationLifetimeRule(),
     KnobDriftRule(),
     RegistryDriftRule(),
 ]

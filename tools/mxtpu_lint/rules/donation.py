@@ -1,0 +1,234 @@
+"""donation-lifetime: no reads of donated buffers after dispatch.
+
+``jax.jit(..., donate_argnums=...)`` invalidates the donated input
+buffers the moment the compiled call dispatches — XLA reuses their
+memory for the outputs. A post-dispatch read (``.addressable_shards``,
+``device_nbytes(...)``, re-dispatching the same binding) raises a
+deleted-buffer RuntimeError at best and, at worst, does so inside an
+error path that was itself trying to explain a crash — the exact PR 13
+OOM-dump failure. The established discipline (``parallel/step.py``'s
+``step.gather`` block) is to re-place every donated binding from the
+program's outputs immediately after dispatch; this rule checks it
+statically:
+
+- a ``jax.jit``/``pjit`` call with ``donate_argnums`` records which
+  positions of the compiled callable are donated (constants are read
+  through one level of local assignment — tuple literals and
+  either/both arms of a conditional expression);
+- the compiled callable is tracked to what it is bound to (a local
+  name or a ``self._compiled``-style attribute), and every call
+  through that binding in the same file is a *dispatch site*;
+- at each dispatch, the argument expressions in donated positions
+  (plain names and ``self.x`` attributes) become *donated bindings*;
+  any load of a donated binding LATER in the same function, before a
+  store re-places it, is an error. A store (``self._master =
+  new_master``, re-assignment from the outputs) ends the donated
+  window for that binding.
+
+Deliberate post-dispatch reads (a buffer provably unused by the
+program, a debug-only path) carry ``# lint: donation-lifetime-ok``
+with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileIndex, FuncInfo, LintRule, dotted_name
+
+
+def _jit_donate_positions(sf, call: ast.Call,
+                          fi: Optional[FuncInfo]) -> Optional[Set[int]]:
+    """Donated argnums of a jax.jit/pjit call, or None when the call
+    is not a jit-with-donation. An unresolvable donate_argnums returns
+    the empty set (we do not guess)."""
+    dn = dotted_name(call.func)
+    leaf = dn.rsplit('.', 1)[-1]
+    if leaf not in ('jit', 'pjit'):
+        return None
+    root = dn.split('.')[0]
+    target = sf.imports.get(root, root if root in ('jax',) else '')
+    if not (dn.startswith('jax.') or str(target).startswith('jax')):
+        return None
+    for kw in call.keywords:
+        if kw.arg == 'donate_argnums':
+            got = _tuple_const(kw.value)
+            if got is not None:
+                return got
+            if isinstance(kw.value, ast.Name) and fi is not None:
+                return _resolve_local_tuple(fi, kw.value.id) or set()
+            return set()
+    return None
+
+
+def _tuple_const(expr) -> Optional[Set[int]]:
+    if isinstance(expr, ast.Tuple):
+        out = set()
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return {expr.value}
+    if isinstance(expr, ast.IfExp):
+        # `donate = (0, 2, 3, 4) if self.donate else ()` — union of the
+        # arms: a position donated on EITHER path must obey the rule
+        a = _tuple_const(expr.body)
+        b = _tuple_const(expr.orelse)
+        if a is not None or b is not None:
+            return (a or set()) | (b or set())
+    return None
+
+
+def _resolve_local_tuple(fi: FuncInfo, name: str) -> Optional[Set[int]]:
+    """`donate = (0, 2) [if ...]` one assignment up-function."""
+    got = None
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign) and \
+                any(isinstance(t, ast.Name) and t.id == name
+                    for t in node.targets):
+            got = _tuple_const(node.value)
+    return got
+
+
+def _binding_key(expr) -> Optional[str]:
+    """Trackable donated-binding identity: a plain name or a
+    ``self.x`` attribute."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == 'self':
+        return f'self.{expr.attr}'
+    return None
+
+
+class DonationLifetimeRule(LintRule):
+    id = 'donation-lifetime'
+    doc = ('reads of donate_argnums-donated buffers after dispatch, '
+           'before the output re-place — deleted-buffer crashes at '
+           'lint time')
+
+    def run(self, index: FileIndex):
+        findings = []
+        for sf in index.files:
+            # 1) jit-with-donation sites -> what the callable binds to
+            dispatchers = self._dispatch_bindings(index, sf)
+            if not dispatchers:
+                continue
+            # 2) per function: dispatch calls, donated args, later use
+            for fi in index.functions.values():
+                if fi.file is not sf:
+                    continue
+                findings.extend(
+                    self._check_function(index, sf, fi, dispatchers))
+        return findings
+
+    def _dispatch_bindings(self, index, sf) -> Dict[str, Set[int]]:
+        """{binding: donated positions}. Binding is 'self._compiled'
+        (any class in file) or a local/global name the jit result is
+        assigned to."""
+        out: Dict[str, Set[int]] = {}
+        for fi in index.functions.values():
+            if fi.file is not sf:
+                continue
+            for node in index.walk_function(fi):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    pos = _jit_donate_positions(sf, node.value, fi)
+                    if not pos:
+                        continue
+                    for tgt in node.targets:
+                        key = _binding_key(tgt)
+                        if key:
+                            out.setdefault(key, set()).update(pos)
+        return out
+
+    def _check_function(self, index, sf, fi, dispatchers):
+        findings = []
+        # dispatch sites in source order
+        calls = [(n.lineno, n) for n in index.walk_function(fi)
+                 if isinstance(n, ast.Call)
+                 and _binding_key(n.func) in dispatchers]
+        if not calls:
+            return findings
+        events = self._events(index, fi)
+        for disp_line, disp in sorted(calls, key=lambda c: c[0]):
+            # a multiline dispatch call's own argument loads end at
+            # end_lineno — only loads strictly after it are post-dispatch
+            disp_end = getattr(disp, 'end_lineno', disp_line)
+            donated: Dict[str, ast.AST] = {}
+            for pos in dispatchers[_binding_key(disp.func)]:
+                if pos < len(disp.args):
+                    key = _binding_key(disp.args[pos])
+                    if key:
+                        donated[key] = disp.args[pos]
+            if not donated:
+                continue
+            replaced: Set[str] = set()
+            # a store ON the dispatch statement is the canonical
+            # single-line re-place (`self._p = self._compiled(self._p)`)
+            # — it closes the donated window immediately; loads in that
+            # range are the call's own arguments
+            for line, kind, key, node in events:
+                if disp_line <= line <= disp_end and kind == 'store' \
+                        and key in donated:
+                    replaced.add(key)
+            for line, kind, key, node in events:
+                if line <= disp_end or key not in donated:
+                    continue
+                if key in replaced:
+                    continue
+                if kind == 'store':
+                    replaced.add(key)
+                    continue
+                extra = ''
+                src_line = sf.lines[line - 1] if line <= len(sf.lines) \
+                    else ''
+                if 'addressable_shards' in src_line:
+                    extra = (' (.addressable_shards materializes the '
+                             'deleted per-device buffers)')
+                elif 'device_nbytes' in src_line:
+                    extra = (' (device_nbytes sums the deleted '
+                             'buffers\N{RIGHT SINGLE QUOTATION MARK} '
+                             'shards)')
+                findings.append(self.finding(
+                    sf, line,
+                    f"{key} was donated to the compiled call in "
+                    f"{fi.qualname} and is read after dispatch without "
+                    f"a re-place — the buffer is deleted the moment "
+                    f"the program launches; rebind it from the "
+                    f"program's outputs first{extra}",
+                    symbol=f'{fi.qualname}:{key}',
+                    data={'binding': key,
+                          'dispatch_line': disp_line}))
+                replaced.add(key)       # one finding per binding/dispatch
+        return findings
+
+    def _events(self, index, fi) -> List[Tuple[int, str, str, ast.AST]]:
+        """(line, 'store'|'load', binding key, node) for every
+        name/self-attr access in the function, source-ordered. A store
+        via tuple unpacking counts; loads that are the dispatch call's
+        own func/args are excluded by line ordering."""
+        events = []
+        for node in index.walk_function(fi):
+            key = None
+            if isinstance(node, ast.Name):
+                key = node.id
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == 'self':
+                key = f'self.{node.attr}'
+            else:
+                continue
+            kind = 'store' if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) else 'load'
+            events.append((node.lineno, kind, key, node))
+        # stores sort before loads on the same line: `x = f(x)` after a
+        # dispatch would otherwise self-flag its own rebinding... the
+        # LOAD there is still a use of the donated buffer, so loads
+        # first is the CORRECT order — a same-line read feeding the
+        # re-place is exactly the pattern that crashes
+        events.sort(key=lambda e: (e[0], e[1] == 'store'))
+        return events

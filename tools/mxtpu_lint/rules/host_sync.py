@@ -15,7 +15,6 @@ reason is the documentation the next reader needs.
 from __future__ import annotations
 
 import ast
-import fnmatch
 
 from .. import contracts
 from ..core import FileIndex, LintRule, dotted_name
@@ -43,14 +42,11 @@ class HostSyncRule(LintRule):
     # -- root/reachability -------------------------------------------------
 
     def _root_keys(self, index: FileIndex):
-        keys = []
-        for suffix, qual_glob in self.roots:
-            for sf in index.files_matching(suffix):
-                for (rel, qual), fi in index.functions.items():
-                    if rel == sf.relpath and fnmatch.fnmatch(qual,
-                                                             qual_glob):
-                        keys.append(fi.key)
-        return keys
+        # the ONE root-table resolver (threads.resolve_root_keys) —
+        # the blocking-under-lock rule resolves its hot-lock roots
+        # through the same helper, so matching semantics cannot diverge
+        from ..threads import resolve_root_keys
+        return resolve_root_keys(index, self.roots)
 
     def run(self, index: FileIndex):
         findings = []

@@ -50,7 +50,7 @@ class JitPurityRule(LintRule):
         tracer call, or decorated with one."""
         out = []
         traced_names = set()
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             if self._tracer_name(sf, node.func) and node.args and \

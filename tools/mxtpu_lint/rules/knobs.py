@@ -47,7 +47,7 @@ class KnobDriftRule(LintRule):
         for sf in index.files:
             if sf.relpath.endswith(self.config_suffix):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 key = self._environ_read_key(sf, node)
                 if key is None or not key.startswith(KNOB_PREFIXES):
                     continue

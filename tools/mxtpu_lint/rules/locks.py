@@ -93,13 +93,11 @@ class LockModel:
         return None
 
     def _find_locks(self):
+        for fi in self.index.functions.values():
+            for node in self.index.walk_function(fi):
+                if isinstance(node, ast.Assign):
+                    self._maybe_lock_assign(fi.file, fi.cls, node)
         for sf in self.index.files:
-            for fi_key, fi in self.index.functions.items():
-                if fi.file is not sf:
-                    continue
-                for node in self.index.walk_function(fi):
-                    if isinstance(node, ast.Assign):
-                        self._maybe_lock_assign(sf, fi.cls, node)
             for node in sf.tree.body:       # module level
                 if isinstance(node, ast.Assign):
                     self._maybe_lock_assign(sf, None, node)
@@ -170,16 +168,52 @@ class LockModel:
     def _cm_acquired_lock(self, sf, cls, call
                           ) -> Optional[Tuple[LockInfo, bool]]:
         """`with self._foo():` — when the callee acquires exactly one
-        lock, the with holds it. Returns (lock, blocking)."""
+        lock, the with holds it. Returns (lock, blocking).
+
+        A ``@contextlib.contextmanager`` generator is different: only
+        locks held AT ITS YIELD are held by the with-body — a CM that
+        takes a lock, updates a counter and RELEASES before yielding
+        (``replica._fetching``) protects nothing in the body, and
+        treating it as held would hide real races behind a phantom
+        lockset."""
         targets = self.index.resolve_call(sf, cls, call.func)
         if len(targets) != 1:
             return None
-        acqs = self.acquires.get(targets[0].key, [])
+        t = targets[0]
+        acqs = self.acquires.get(t.key, [])
+        if self._is_generator_cm(t):
+            ylines = [n.lineno for n in self.index.walk_function(t)
+                      if isinstance(n, (ast.Yield, ast.YieldFrom))]
+            if not ylines:
+                return None
+            at_yield = []
+            for a in acqs:
+                if a.via_with and a.body:
+                    start = a.body[0].lineno
+                    end = max(getattr(s, 'end_lineno', s.lineno)
+                              for s in a.body)
+                    if start <= ylines[0] <= end:
+                        at_yield.append(a)
+                elif not a.via_with and a.node.lineno < ylines[0]:
+                    # acquire()/yield/finally-release shape (flight's
+                    # crash-tolerant `_locked_for_dump`): held across
+                    # the yield
+                    at_yield.append(a)
+            if len(at_yield) == 1:
+                return (at_yield[0].lock, at_yield[0].blocking)
+            return None
         locks = {a.lock.key for a in acqs}
         if len(locks) != 1:
             return None
         a = acqs[0]
         return (a.lock, a.blocking)
+
+    @staticmethod
+    def _is_generator_cm(fi) -> bool:
+        for dec in fi.node.decorator_list:
+            if dotted_name(dec).endswith('contextmanager'):
+                return True
+        return False
 
     def _find_acquires(self):
         # two passes: direct with/acquire sites first, so the second
@@ -361,45 +395,11 @@ class SignalSafetyRule(LintRule):
 
     def _handler_roots(self, index: FileIndex):
         """(FuncInfo, registration description) for every handler
-        passed to signal.signal / atexit.register."""
-        roots = []
-        for sf in index.files:
-            for node in ast.walk(sf.tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                dn = dotted_name(node.func)
-                is_sig = dn.endswith('.signal') and \
-                    sf.imports.get(dn.split('.')[0], '').startswith(
-                        'signal')
-                is_atexit = dn.endswith('.register') and \
-                    sf.imports.get(dn.split('.')[0], '') == 'atexit'
-                if not (is_sig or is_atexit):
-                    continue
-                args = node.args
-                handler_expr = args[1] if is_sig and len(args) > 1 else \
-                    (args[0] if is_atexit and args else None)
-                if handler_expr is None:
-                    continue
-                kind = 'signal handler' if is_sig else 'atexit hook'
-                # file only, no line: the registration site lands in
-                # the finding MESSAGE, which must stay line-stable for
-                # the baseline fingerprint
-                where = sf.relpath
-                if isinstance(handler_expr, ast.Call):
-                    # factory: the built handler is lexically inside it
-                    for t in index.resolve_call(sf, None,
-                                                handler_expr.func):
-                        roots.append((t, kind, where))
-                    continue
-                # skip signal.SIG_DFL / SIG_IGN restores
-                dn_h = dotted_name(handler_expr)
-                if dn_h.endswith(('SIG_DFL', 'SIG_IGN')):
-                    continue
-                encl = index.enclosing_function(sf, node)
-                cls = encl.cls if encl is not None else None
-                for t in index.resolve_call(sf, cls, handler_expr):
-                    roots.append((t, kind, where))
-        return roots
+        passed to signal.signal / atexit.register — the shared
+        ``threads.handler_registrations`` walker (the thread model
+        reuses the same discovery)."""
+        from ..threads import handler_registrations
+        return handler_registrations(index)
 
     def run(self, index: FileIndex):
         model = lock_model(index)

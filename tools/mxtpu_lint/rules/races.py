@@ -1,0 +1,321 @@
+"""lockset-race + blocking-under-lock over the thread model.
+
+**lockset-race**: for every ``self._x`` / ``global``-written module
+name that is WRITTEN from one thread root and read or written from
+another (or from a second instance of a multi-instance root — the
+handler pool), the two accesses must share at least one held lock.
+An empty lockset intersection is a data race: the report names both
+access sites, their thread roots, and the candidate lock (the lock
+most often held at this attribute's other access sites — usually the
+one the missing ``with`` should take). Intentionally lock-free paths
+(the telemetry rings' single-writer design) carry a reasoned
+``# lint: lockset-race-ok`` on the write line.
+
+**blocking-under-lock**: a call that can block unboundedly —
+``socket.accept/recv``, ``Thread.join()``/``Event.wait()`` with no
+timeout, zero-arg ``queue.get()``, ``subprocess`` without
+``timeout=``, ``time.sleep`` at/above threshold, plus the
+``contracts.BLOCKING_CALLEES`` annotations — while holding a lock
+that a hot path (step dispatch, heartbeat handling, metric scrape;
+``contracts.HOT_LOCK_ROOTS``) also acquires, stalls that hot path
+for the duration. Reported at the blocking site with the lock and the
+hot roots that contend on it; call edges are followed, so a helper
+that blocks is caught from the ``with`` that holds the lock.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import contracts
+from ..core import FileIndex, FuncInfo, LintRule
+from ..threads import ThreadModel, resolve_root_keys, thread_model
+
+# time.sleep at/above this many seconds under a hot lock is a stall
+SLEEP_THRESHOLD_SECONDS = 1.0
+
+
+def _short(lock_key: str) -> str:
+    return lock_key.split('::', 1)[1] if '::' in lock_key else lock_key
+
+
+class LocksetRaceRule(LintRule):
+    id = 'lockset-race'
+    doc = ('shared attributes written from one thread root and '
+           'accessed from another must share a held lock '
+           '(empty lockset intersection = data race)')
+
+    def __init__(self, model: Optional[ThreadModel] = None):
+        self._model = model
+
+    def run(self, index: FileIndex):
+        model = self._model if self._model is not None \
+            and self._model.index is index else thread_model(index)
+        root_table = model._roots_by_ident
+        findings = []
+        for attr, accs in sorted(model.attribute_accesses().items()):
+            writes = [a for a in accs if a.kind == 'write']
+            if not writes:
+                continue
+            # cache per-access roots/locksets once per attribute
+            sites = [(a, model.roots_of(a.fi.key),
+                      model.lockset_at(a.fi, a.node)) for a in accs]
+            w_sites = [(a, r, l) for a, r, l in sites
+                       if a.kind == 'write']
+            w_sites.sort(key=lambda s: (s[0].fi.file.relpath,
+                                        s[0].node.lineno))
+            # one finding per conflicting WRITE site (not one per
+            # attribute): a suppression on one racy write must not
+            # silently swallow a DIFFERENT unprotected write to the
+            # same attribute. Within one write, the first conflicting
+            # other-access is representative — fixing the write fixes
+            # every pair it anchors.
+            reported_writes = set()
+            for w, w_roots, w_locks in w_sites:
+                wkey = (w.fi.key, w.node.lineno)
+                if wkey in reported_writes:
+                    continue
+                conflict = None
+                for o, o_roots, o_locks in sites:
+                    if o is w:
+                        continue
+                    if not self._concurrent_pair(model, root_table,
+                                                 w, w_roots,
+                                                 o, o_roots):
+                        continue
+                    if w_locks & o_locks:
+                        continue
+                    conflict = (o, o_roots, o_locks)
+                    break
+                if conflict is None:
+                    continue
+                reported_writes.add(wkey)
+                o, o_roots, o_locks = conflict
+                candidate = self._candidate_lock(sites)
+                hint = (f"candidate lock: {_short(candidate)} (held at "
+                        f"this attribute's other access sites)"
+                        if candidate else
+                        "no lock is held at ANY access site of this "
+                        "attribute — pick one and take it on both sides")
+                other_verb = 'written' if o.kind == 'write' else 'read'
+                findings.append(self.finding(
+                    w.fi.file, w.node.lineno,
+                    f"{_short(attr)} is written by {w.fi.qualname}"
+                    f"{w.detail and ' via ' + w.detail} on "
+                    f"{model.describe_roots(w_roots)} and {other_verb} "
+                    f"by {o.fi.qualname} on "
+                    f"{model.describe_roots(o_roots)} "
+                    f"with no common lock "
+                    f"(locksets {self._fmt_locks(w_locks)} vs "
+                    f"{self._fmt_locks(o_locks)}) — {hint}",
+                    symbol=attr.split('::', 1)[1],
+                    data={
+                        'attr': attr,
+                        'write': {'symbol': w.fi.qualname,
+                                  'path': w.fi.file.relpath,
+                                  'line': w.node.lineno,
+                                  'thread_roots': sorted(w_roots),
+                                  'locks': sorted(w_locks)},
+                        'other': {'symbol': o.fi.qualname,
+                                  'path': o.fi.file.relpath,
+                                  'line': o.node.lineno, 'kind': o.kind,
+                                  'thread_roots': sorted(o_roots),
+                                  'locks': sorted(o_locks)},
+                        'candidate_lock': candidate,
+                    }))
+        return findings
+
+    @staticmethod
+    def _concurrent_pair(model, root_table, w, w_roots, o, o_roots):
+        """Concurrency with the happens-before refinement: an access in
+        the function that spawns the other side's root, ABOVE the
+        spawn, is published by ``Thread.start()`` and cannot race that
+        root (the ``start()`` method's reset-then-spawn pattern)."""
+        for a in w_roots:
+            for b in o_roots:
+                if a == b:
+                    r = root_table.get(a)
+                    if r is not None and r.multi:
+                        return True
+                    continue
+                if model.happens_before_spawn(
+                        w.fi.key, w.node.lineno, b):
+                    continue
+                if model.happens_before_spawn(
+                        o.fi.key, o.node.lineno, a):
+                    continue
+                return True
+        return False
+
+    @staticmethod
+    def _fmt_locks(locks) -> str:
+        return '{' + ', '.join(sorted(_short(k) for k in locks)) + '}' \
+            if locks else '{}'
+
+    @staticmethod
+    def _candidate_lock(sites) -> Optional[str]:
+        counts: Dict[str, int] = {}
+        for _a, _r, locks in sites:
+            for lk in locks:
+                counts[lk] = counts.get(lk, 0) + 1
+        if not counts:
+            return None
+        return max(sorted(counts), key=lambda k: counts[k])
+
+
+class BlockingUnderLockRule(LintRule):
+    id = 'blocking-under-lock'
+    doc = ('unboundedly-blocking calls (socket recv/accept, '
+           'no-timeout join/wait/get, subprocess, long sleeps) while '
+           'holding a lock a hot path also acquires')
+
+    def __init__(self, hot_roots=None, model: Optional[ThreadModel] = None,
+                 sleep_threshold=SLEEP_THRESHOLD_SECONDS,
+                 blocking_callees=None):
+        self.hot_roots = hot_roots if hot_roots is not None \
+            else contracts.HOT_LOCK_ROOTS
+        self._model = model
+        self.sleep_threshold = float(sleep_threshold)
+        self.blocking_callees = blocking_callees \
+            if blocking_callees is not None else \
+            contracts.BLOCKING_CALLEES
+
+    def run(self, index: FileIndex):
+        model = self._model if self._model is not None \
+            and self._model.index is index else thread_model(index)
+        locks = model.locks
+        # hot lock set: every lock acquired in the cone of a hot root,
+        # remembering WHICH roots contend on each lock
+        hot_locks: Dict[str, Set[str]] = {}
+        for suffix, glob in self.hot_roots:
+            for key in resolve_root_keys(index, [(suffix, glob)]):
+                qual = f'{key[0]}::{key[1]}'
+                for lk in locks.reachable_acquires(key):
+                    hot_locks.setdefault(lk, set()).add(qual)
+        if not hot_locks:
+            return []
+        # annotated blocking callees -> FuncInfo keys
+        annotated = set(resolve_root_keys(index, self.blocking_callees))
+        self._reach_cache: Dict[Tuple[str, str], Optional[tuple]] = {}
+        findings = []
+        reported = set()
+        for fi in index.functions.values():
+            for acq in locks.acquires.get(fi.key, ()):
+                if not acq.via_with or acq.lock.key not in hot_locks:
+                    continue
+                roots = hot_locks[acq.lock.key]
+                for stmt in acq.body:
+                    for node in ast.walk(stmt):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        hit = self._blocking_call(index, fi, node,
+                                                  annotated)
+                        via = ''
+                        if hit is None:
+                            # call edges: a helper that blocks, called
+                            # while the lock is held
+                            for tgt in index.resolve_call(
+                                    fi.file, fi.cls, node.func):
+                                got = self._reaches_blocking(
+                                    index, tgt.key, annotated)
+                                if got:
+                                    hit, blocker = got
+                                    via = (f" (via call chain into "
+                                           f"{blocker})")
+                                    break
+                        if hit is None:
+                            continue
+                        dedup = (fi.key, node.lineno, acq.lock.key)
+                        if dedup in reported:
+                            continue
+                        reported.add(dedup)
+                        findings.append(self.finding(
+                            fi.file, node.lineno,
+                            f"{hit}{via} while {fi.qualname} holds "
+                            f"{_short(acq.lock.key)}, which the hot "
+                            f"path(s) {sorted(roots)} also acquire — "
+                            f"the hot path stalls for the full "
+                            f"blocking duration",
+                            symbol=fi.qualname,
+                            data={'lock': acq.lock.key,
+                                  'hot_roots': sorted(roots),
+                                  'blocking': hit}))
+        return findings
+
+    # -- blocking-site predicate ------------------------------------------
+
+    def _blocking_call(self, index, fi: FuncInfo, node: ast.Call,
+                       annotated) -> Optional[str]:
+        sf = fi.file
+        func = node.func
+        # annotated callees (contracts.BLOCKING_CALLEES)
+        for tgt in index.resolve_call(sf, fi.cls, func):
+            if tgt.key in annotated:
+                return (f"{tgt.qualname}() is lint-registered as "
+                        f"unboundedly blocking")
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        recv_is_module = (isinstance(recv, ast.Name)
+                          and recv.id in sf.imports)
+        has_timeout_kw = any(kw.arg in ('timeout', 'block')
+                             for kw in node.keywords)
+        if recv_is_module:
+            mod = sf.imports[recv.id]
+            if mod == 'time' and attr == 'sleep' and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and \
+                        isinstance(a0.value, (int, float)):
+                    if a0.value >= self.sleep_threshold:
+                        return f"time.sleep({a0.value}s)"
+                    return None
+                return "time.sleep(<unbounded-by-inspection>)"
+            if mod == 'subprocess' and attr in (
+                    'run', 'call', 'check_call', 'check_output') and \
+                    not has_timeout_kw:
+                return f"subprocess.{attr}() without timeout="
+            return None
+        if attr in ('accept', 'recv', 'recvfrom', 'recv_into'):
+            return (f".{attr}() — blocks until the peer sends "
+                    f"(bounded only by an explicit socket timeout)")
+        if attr == 'communicate' and not has_timeout_kw:
+            return ".communicate() without timeout="
+        if attr in ('join', 'wait', 'get') and not node.args and \
+                not has_timeout_kw:
+            what = {'join': 'Thread.join()', 'wait': '.wait()',
+                    'get': '.get()'}[attr]
+            return f"{what} with no timeout"
+        return None
+
+    def _reaches_blocking(self, index, key, annotated):
+        """First blocking site reachable from `key` over call edges
+        ((desc, qualname) or None), cached."""
+        if key in self._reach_cache:
+            return self._reach_cache[key]
+        edges = index.call_edges()
+        seen = set()
+        stack = [key]
+        found = None
+        while stack and found is None:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            fi = index.functions.get(k)
+            if fi is None:
+                continue
+            for node in index.walk_function(fi):
+                if isinstance(node, ast.Call):
+                    hit = self._blocking_call(index, fi, node,
+                                              annotated)
+                    if hit is not None:
+                        found = (hit, fi.qualname)
+                        break
+            stack.extend(edges.get(k, ()))
+        if found is None:
+            # a clean cone is clean for every function in it
+            for k in seen:
+                self._reach_cache.setdefault(k, None)
+        self._reach_cache[key] = found
+        return found

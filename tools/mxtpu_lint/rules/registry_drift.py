@@ -38,7 +38,7 @@ def parse_fault_sites(index: FileIndex,
     """Keys of the ``_SITES`` dict literal, or None when the registry
     file is not in the tree (fixture runs pass sites explicitly)."""
     for sf in index.files_matching(registry_suffix):
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Assign) and \
                     any(isinstance(t, ast.Name) and t.id == '_SITES'
                         for t in node.targets) and \
@@ -58,7 +58,7 @@ def scan_metrics(index: FileIndex):
     names: Dict[str, Set[str]] = {}
     errors: List[Tuple[str, int, str, str]] = []
     for sf in index.files:
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             func = node.func
@@ -137,7 +137,7 @@ class RegistryDriftRule(LintRule):
         if sites is None:
             sites = parse_fault_sites(index)
         for sf in index.files:
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 cn = call_name(node)

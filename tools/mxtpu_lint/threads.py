@@ -1,0 +1,580 @@
+"""Thread-lifecycle model: which threads can execute which function.
+
+The repo runs ~10 long-lived thread kinds (membership coordinator +
+heartbeat senders, replica server + push worker, scrubber, watchdog,
+checkpoint writer, metrics-endpoint handler pool, fleet monitor, IO
+prefetchers). The PR 12-13 review trail shows the dominant residual
+bug class is shared-state mutation outside the owning lock — exactly
+what a reviewer has to reconstruct by hand from "who spawns what".
+This module computes that reconstruction once, on the shared
+``FileIndex``/call-graph substrate, for the lockset-race and
+blocking-under-lock rules:
+
+- **Thread-root discovery** (``ThreadModel.roots``): every
+  ``threading.Thread(target=...)`` / ``threading.Timer`` construction
+  — including targets reached through a factory call (the root is the
+  returned closure) and ``self._method`` references — becomes a
+  spawned root. A spawn site lexically inside a loop (the endpoint
+  handler pool) is marked *multi-instance*: two copies of that root
+  run concurrently with EACH OTHER, not just with other roots.
+- **Root annotation** (``roots_of``): each function's set of roots,
+  from per-root reachability over the call graph plus a ``main``
+  pseudo-root seeded at every function no spawned root reaches
+  (anything main can then call transitively is also main).
+  ``signal``/``atexit`` handlers execute ON the main thread (they
+  interleave, they do not parallelise), so for race purposes they
+  belong to ``main`` — their reentrancy hazards stay the
+  signal-safety rule's job.
+- **Held-lockset inference** (``lockset_at``): lexical ``with <lock>``
+  nesting plus call-edge propagation — a function's entry lockset is
+  the INTERSECTION over its known call sites of (caller entry lockset
+  | locks lexically held at the site), computed to fixpoint. A lock a
+  function only sometimes holds protects nothing.
+- **Shared-state access table** (``attribute_accesses``): every
+  ``self._x`` store / mutating-method call / load, keyed like the lock
+  model (``relpath::Class.attr``), plus module globals declared with
+  ``global``. ``__init__`` writes are exempt — ``Thread.start()`` is
+  the happens-before edge that publishes them.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileIndex, FuncInfo, dotted_name
+from .rules.locks import LockModel, lock_model
+
+MAIN_ROOT = 'main'
+
+# a call to one of these METHOD names mutates the receiver in place —
+# `self._queue.append(x)` is a write of `self._queue` for race purposes
+# even though the AST only shows a Load of the attribute
+MUTATOR_METHODS = frozenset({
+    'append', 'extend', 'insert', 'remove', 'pop', 'popleft',
+    'appendleft', 'clear', 'add', 'discard', 'update', 'setdefault',
+    'sort', 'reverse',
+})
+
+
+class ThreadRoot:
+    """One concurrent entry point."""
+
+    __slots__ = ('ident', 'kind', 'key', 'display', 'where', 'multi',
+                 'spawn_sites')
+
+    def __init__(self, ident, kind, key, display, where, multi=False):
+        self.ident = ident       # stable id, e.g. 'thread:f.py::C.run'
+        self.kind = kind         # 'thread' | 'timer' | 'main'
+        self.key = key           # FuncInfo key of the target, or None
+        self.display = display   # human name (thread name= when given)
+        self.where = where       # spawning file relpath
+        self.multi = multi       # spawn site inside a loop: >1 instance
+        self.spawn_sites: List[Tuple[Tuple[str, str], int]] = []
+        #                        # (spawning function key, line)
+
+    def __repr__(self):
+        return f"ThreadRoot({self.ident}, multi={self.multi})"
+
+
+class Access:
+    """One shared-state access site."""
+
+    __slots__ = ('attr', 'kind', 'fi', 'node', 'detail')
+
+    def __init__(self, attr, kind, fi, node, detail=''):
+        self.attr = attr         # 'relpath::Class.attr' / 'relpath::name'
+        self.kind = kind         # 'write' | 'read'
+        self.fi = fi
+        self.node = node
+        self.detail = detail     # e.g. '.append()' for mutator writes
+
+
+def resolve_root_keys(index: FileIndex, roots) -> List[Tuple[str, str]]:
+    """(relpath suffix, qualname glob) pairs -> live FuncInfo keys
+    (the host-sync rule's root resolution, shared)."""
+    import fnmatch
+    keys = []
+    for suffix, qual_glob in roots:
+        for sf in index.files_matching(suffix):
+            for (rel, qual), fi in index.functions.items():
+                if rel == sf.relpath and fnmatch.fnmatch(qual, qual_glob):
+                    keys.append(fi.key)
+    return keys
+
+
+def handler_registrations(index: FileIndex):
+    """(FuncInfo, kind, registering relpath) for every handler passed
+    to ``signal.signal`` / ``atexit.register`` — factories included (a
+    nested handler is reachable from the factory that builds it).
+    Shared by the signal-safety rule and the thread model."""
+    roots = []
+    for sf in index.files:
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            is_sig = dn.endswith('.signal') and \
+                sf.imports.get(dn.split('.')[0], '').startswith('signal')
+            is_atexit = dn.endswith('.register') and \
+                sf.imports.get(dn.split('.')[0], '') == 'atexit'
+            if not (is_sig or is_atexit):
+                continue
+            args = node.args
+            handler_expr = args[1] if is_sig and len(args) > 1 else \
+                (args[0] if is_atexit and args else None)
+            if handler_expr is None:
+                continue
+            kind = 'signal handler' if is_sig else 'atexit hook'
+            where = sf.relpath
+            if isinstance(handler_expr, ast.Call):
+                # factory: the built handler is lexically inside it
+                for t in index.resolve_call(sf, None, handler_expr.func):
+                    roots.append((t, kind, where))
+                continue
+            dn_h = dotted_name(handler_expr)
+            if dn_h.endswith(('SIG_DFL', 'SIG_IGN')):
+                continue
+            encl = index.enclosing_function(sf, node)
+            cls = encl.cls if encl is not None else None
+            for t in index.resolve_call(sf, cls, handler_expr):
+                roots.append((t, kind, where))
+    return roots
+
+
+class ThreadModel:
+    """Roots, per-function root sets, entry locksets and shared-state
+    accesses for one FileIndex. Built once, shared by the lockset-race
+    and blocking-under-lock rules."""
+
+    def __init__(self, index: FileIndex,
+                 locks: Optional[LockModel] = None):
+        self.index = index
+        self.locks = locks if locks is not None else lock_model(index)
+        self.roots: List[ThreadRoot] = []
+        self._roots_by_ident: Dict[str, ThreadRoot] = {}
+        self._find_spawn_roots()
+        self._roots_of: Dict[Tuple[str, str], Set[str]] = {}
+        self._annotate_roots()
+        self._held_ranges: Dict[Tuple[str, str],
+                                List[Tuple[str, int, int]]] = {}
+        self._build_held_ranges()
+        self.entry_locksets: Dict[Tuple[str, str], frozenset] = {}
+        self._compute_entry_locksets()
+        self._accesses: Optional[Dict[str, List[Access]]] = None
+
+    # -- root discovery ----------------------------------------------------
+
+    def _thread_ctor_kind(self, sf, call: ast.Call) -> Optional[str]:
+        dn = dotted_name(call.func)
+        if '.' in dn:
+            mod, attr = dn.rsplit('.', 1)
+            if sf.imports.get(mod, mod) == 'threading' and \
+                    attr in ('Thread', 'Timer'):
+                return attr.lower()
+        elif dn in ('Thread', 'Timer') and \
+                sf.imports.get(dn, '').startswith('threading'):
+            return dn.lower()
+        return None
+
+    @staticmethod
+    def _target_expr(kind, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg == ('target' if kind == 'thread' else 'function'):
+                return kw.value
+        if kind == 'timer' and len(call.args) > 1:
+            return call.args[1]
+        return None
+
+    @staticmethod
+    def _thread_name(call: ast.Call) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg == 'name' and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return None
+
+    def _closure_targets(self, factory: FuncInfo) -> List[FuncInfo]:
+        """Closures a factory returns (the actual thread bodies when a
+        target is built by a factory call)."""
+        by_name = {n.name: n for n in factory.nested}
+        out = []
+        for node in self.index.walk_function(factory):
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in by_name:
+                out.append(by_name[node.value.id])
+        return out
+
+    def _find_spawn_roots(self):
+        # spawn sites inside functions only: a module-level
+        # Thread(...) would run at import time, which this codebase
+        # (correctly) never does
+        seen = set()
+        for fi in self.index.functions.values():
+            sf, cls = fi.file, fi.cls
+            loop_ranges = [
+                (n.lineno, getattr(n, 'end_lineno', n.lineno))
+                for n in self.index.walk_function(fi)
+                if isinstance(n, (ast.For, ast.While))]
+            for node in self.index.walk_function(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._thread_ctor_kind(sf, node)
+                if kind is None:
+                    continue
+                tgt = self._target_expr(kind, node)
+                if tgt is None:
+                    continue
+                in_loop = any(s <= node.lineno <= e
+                              for s, e in loop_ranges)
+                targets: List[FuncInfo] = []
+                if isinstance(tgt, ast.Call):
+                    for fac in self.index.resolve_call(sf, cls, tgt.func):
+                        closures = self._closure_targets(fac)
+                        targets.extend(closures if closures else [fac])
+                elif isinstance(tgt, ast.Name):
+                    # a closure target defined in the spawning function
+                    # itself (`def worker(): ...` then
+                    # `Thread(target=worker)`) — resolve_call only sees
+                    # module scope, so check the local nest first
+                    scope, local = fi, None
+                    while scope is not None and local is None:
+                        for n in scope.nested:
+                            if n.name == tgt.id:
+                                local = n
+                                break
+                        scope = scope.parent
+                    if local is not None:
+                        targets.append(local)
+                    else:
+                        targets.extend(
+                            self.index.resolve_call(sf, cls, tgt))
+                else:
+                    targets.extend(self.index.resolve_call(sf, cls, tgt))
+                tname = self._thread_name(node)
+                for t in targets:
+                    ident = f'{kind}:{t.file.relpath}::{t.qualname}'
+                    display = tname or t.qualname
+                    if ident in seen:
+                        # a second spawn site of the same target means
+                        # >1 live instance of that root
+                        prior = self._roots_by_ident[ident]
+                        prior.multi = True
+                        prior.spawn_sites.append((fi.key, node.lineno))
+                        continue
+                    seen.add(ident)
+                    root = ThreadRoot(ident, kind, t.key, display,
+                                      sf.relpath, multi=in_loop)
+                    root.spawn_sites.append((fi.key, node.lineno))
+                    self.roots.append(root)
+                    self._roots_by_ident[ident] = root
+
+    # -- per-function root annotation --------------------------------------
+
+    def _annotate_roots(self):
+        spawned_reach: Dict[str, Set[Tuple[str, str]]] = {}
+        for root in self.roots:
+            reached = set(self.index.reachable([root.key]))
+            spawned_reach[root.ident] = reached
+            for k in reached:
+                self._roots_of.setdefault(k, set()).add(root.ident)
+        in_spawned = set()
+        for reached in spawned_reach.values():
+            in_spawned |= reached
+        # main: everything no spawned root reaches, then everything
+        # main can call from there (a helper shared with a thread loop
+        # runs on both)
+        main_seeds = [k for k in self.index.functions
+                      if k not in in_spawned]
+        for k in self.index.reachable(main_seeds):
+            self._roots_of.setdefault(k, set()).add(MAIN_ROOT)
+
+    def roots_of(self, key) -> Set[str]:
+        """Thread-root idents that can execute function `key`."""
+        return self._roots_of.get(key, {MAIN_ROOT})
+
+    def root(self, ident) -> Optional[ThreadRoot]:
+        return self._roots_by_ident.get(ident)
+
+    def describe_roots(self, idents) -> str:
+        out = []
+        for ident in sorted(idents):
+            r = self._roots_by_ident.get(ident)
+            if r is None:
+                out.append(ident)
+            else:
+                out.append(f"{r.kind}[{r.display}]"
+                           + ('(xN)' if r.multi else ''))
+        return '{' + ', '.join(out) + '}'
+
+    @staticmethod
+    def concurrent(roots_a, roots_b, root_table) -> bool:
+        """Can an execution under `roots_a` run concurrently with one
+        under `roots_b`? Different roots: yes. The same single spawned
+        root: only if it is multi-instance."""
+        for a in roots_a:
+            for b in roots_b:
+                if a != b:
+                    return True
+                r = root_table.get(a)
+                if r is not None and r.multi:
+                    return True
+        return False
+
+    def happens_before_spawn(self, fi_key, line, root_ident) -> bool:
+        """Does an access at (fi_key, line) happen-before every spawn
+        of `root_ident`? True when ALL of the root's spawn sites live
+        in the accessing function BELOW the access — ``Thread.start()``
+        publishes everything written before it (the ``start()`` method
+        pattern: reset state, then spawn). A root also spawned from
+        elsewhere gets no exemption."""
+        r = self._roots_by_ident.get(root_ident)
+        if r is None or not r.spawn_sites:
+            return False
+        return all(k == fi_key and spawn_line > line
+                   for k, spawn_line in r.spawn_sites)
+
+    # -- held-lockset inference --------------------------------------------
+
+    def _build_held_ranges(self):
+        """Per function: (lock key, start line, end line) for every
+        lexical `with <lock>:` (including CM-resolved ones)."""
+        for key, acqs in self.locks.acquires.items():
+            ranges = []
+            for a in acqs:
+                if not a.via_with or not a.body:
+                    continue
+                start = a.body[0].lineno
+                end = max(getattr(s, 'end_lineno', s.lineno)
+                          for s in a.body)
+                ranges.append((a.lock.key, start, end))
+            if ranges:
+                self._held_ranges[key] = ranges
+
+    def lexical_locks_at(self, fi: FuncInfo, node) -> frozenset:
+        ranges = self._held_ranges.get(fi.key, ())
+        return frozenset(lk for lk, s, e in ranges
+                         if s <= node.lineno <= e)
+
+    def _compute_entry_locksets(self):
+        """Fixpoint: entry[f] = ∩ over call sites of (entry[caller] |
+        locks lexically held at the site). Functions with no known
+        callers (roots, public API) start — and stay — at ∅."""
+        index = self.index
+        # call sites: callee -> [(caller key, held-at-site frozenset)]
+        sites: Dict[Tuple[str, str],
+                    List[Tuple[Tuple[str, str], frozenset]]] = {}
+        for fi in index.functions.values():
+            for node in index.walk_function(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = self.lexical_locks_at(fi, node)
+                for tgt in index.resolve_call(fi.file, fi.cls,
+                                              node.func):
+                    sites.setdefault(tgt.key, []).append((fi.key, held))
+        TOP = None      # lattice top: intersection identity
+        entry: Dict[Tuple[str, str], Optional[frozenset]] = {
+            k: (TOP if k in sites else frozenset())
+            for k in index.functions}
+        for _sweep in range(12):         # converges in a few sweeps
+            changed = False
+            for k, callers in sites.items():
+                acc = TOP
+                for caller_key, held in callers:
+                    ce = entry.get(caller_key)
+                    val = held if ce is None else (ce | held)
+                    acc = val if acc is None else (acc & val)
+                if acc is None:
+                    acc = frozenset()
+                if entry[k] != acc:
+                    entry[k] = acc
+                    changed = True
+            if not changed:
+                break
+        self.entry_locksets = {
+            k: (v if v is not None else frozenset())
+            for k, v in entry.items()}
+
+    def lockset_at(self, fi: FuncInfo, node) -> frozenset:
+        """Locks guaranteed held when `node` executes inside `fi`."""
+        return self.entry_locksets.get(fi.key, frozenset()) | \
+            self.lexical_locks_at(fi, node)
+
+    # -- shared-state accesses ---------------------------------------------
+
+    # attributes holding one of these are internally synchronized (or
+    # per-thread, for threading.local) — calls through them are not
+    # shared-state races
+    _SYNC_CTORS = frozenset({
+        'Lock', 'RLock', 'Condition', 'Semaphore', 'BoundedSemaphore',
+        'Event', 'Barrier', 'local',                    # threading.*
+        'Queue', 'LifoQueue', 'PriorityQueue', 'SimpleQueue',  # queue.*
+    })
+
+    def _sync_attrs(self) -> Set[str]:
+        """Attr/global keys assigned from a threading/queue primitive
+        constructor anywhere — exempt from race analysis."""
+        cached = getattr(self, '_sync_attr_cache', None)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for fi in self.index.functions.values():
+            for node in self.index.walk_function(fi):
+                if isinstance(node, ast.Assign) and \
+                        self._is_sync_ctor(fi.file, node.value):
+                    for tgt in node.targets:
+                        key = self._self_attr_key(fi, tgt)
+                        if key is None and isinstance(tgt, ast.Name):
+                            key = f'{fi.file.relpath}::{tgt.id}'
+                        if key:
+                            out.add(key)
+        for sf in self.index.files:
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        self._is_sync_ctor(sf, node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.add(f'{sf.relpath}::{tgt.id}')
+        self._sync_attr_cache = out
+        return out
+
+    def _is_sync_ctor(self, sf, value) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        dn = dotted_name(value.func)
+        leaf = dn.rsplit('.', 1)[-1]
+        if leaf not in self._SYNC_CTORS:
+            return False
+        root = dn.split('.')[0]
+        mod = sf.imports.get(root, root)
+        return mod in ('threading', 'queue') or \
+            (root == leaf and sf.imports.get(leaf, '').startswith(
+                ('threading', 'queue')))
+
+    def attribute_accesses(self) -> Dict[str, List[Access]]:
+        """attr key -> accesses. ``__init__`` writes are exempt, and so
+        are attributes holding synchronization primitives (their
+        methods are internally locked; ``threading.local`` is
+        per-thread by construction)."""
+        if self._accesses is not None:
+            return self._accesses
+        out: Dict[str, List[Access]] = {}
+        for fi in self.index.functions.values():
+            if fi.name == '__init__':
+                continue
+            self._collect_accesses(fi, out)
+        for key in self._sync_attrs():
+            out.pop(key, None)
+        self._accesses = out
+        return out
+
+    def _module_global_names(self, sf) -> Set[str]:
+        """Names written via ``global`` anywhere in the module — the
+        only module globals tracked (plain module constants are
+        initialization, not shared mutable state)."""
+        cached = getattr(sf, '_global_written', None)
+        if cached is not None:
+            return cached
+        names: Set[str] = set()
+        for node in sf.walk():
+            if isinstance(node, ast.Global):
+                names.update(node.names)
+        sf._global_written = names
+        return names
+
+    def _collect_accesses(self, fi: FuncInfo, out):
+        file = fi.file
+        globals_written = self._module_global_names(file)
+        declared_global: Set[str] = set()
+        local_stores: Set[str] = set()
+        body = self.index.walk_function(fi)
+        for node in body:
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                local_stores.add(node.id)
+        mutated_attr_loads = set()      # Attribute node ids already
+        #                                 counted as mutator writes
+        for node in body:
+            if isinstance(node, ast.AugAssign):
+                # `self.x += 1` is a read-modify-WRITE: the Store node
+                # below records the write; record the implied read too,
+                # or a lost-update race between two instances of the
+                # same root (the handler pool's `requests += 1`) has no
+                # second access to conflict with
+                key = self._self_attr_key(fi, node.target)
+                if key is None and \
+                        isinstance(node.target, ast.Name) and \
+                        node.target.id in declared_global:
+                    key = f'{file.relpath}::{node.target.id}'
+                if key is not None:
+                    out.setdefault(key, []).append(Access(
+                        key, 'read', fi, node.target,
+                        detail='+= read-modify-write'))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATOR_METHODS:
+                recv = node.func.value
+                key = self._self_attr_key(fi, recv)
+                if key is not None:
+                    mutated_attr_loads.add(id(recv))
+                    out.setdefault(key, []).append(Access(
+                        key, 'write', fi, node,
+                        detail=f'.{node.func.attr}()'))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                key = self._self_attr_key(fi, node.value)
+                if key is not None:
+                    mutated_attr_loads.add(id(node.value))
+                    out.setdefault(key, []).append(Access(
+                        key, 'write', fi, node, detail='[...] ='))
+        for node in body:
+            key = self._self_attr_key(fi, node)
+            if key is not None:
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    out.setdefault(key, []).append(
+                        Access(key, 'write', fi, node))
+                elif id(node) not in mutated_attr_loads:
+                    out.setdefault(key, []).append(
+                        Access(key, 'read', fi, node))
+                continue
+            if isinstance(node, ast.Name):
+                name = node.id
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    if name in declared_global:
+                        gkey = f'{file.relpath}::{name}'
+                        out.setdefault(gkey, []).append(
+                            Access(gkey, 'write', fi, node))
+                elif name in globals_written and \
+                        name not in local_stores and \
+                        name not in self._param_names(fi):
+                    gkey = f'{file.relpath}::{name}'
+                    out.setdefault(gkey, []).append(
+                        Access(gkey, 'read', fi, node))
+
+    @staticmethod
+    def _param_names(fi: FuncInfo) -> Set[str]:
+        a = fi.node.args
+        return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)} \
+            | ({a.vararg.arg} if a.vararg else set()) \
+            | ({a.kwarg.arg} if a.kwarg else set())
+
+    def _self_attr_key(self, fi: FuncInfo, node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == 'self' and fi.cls:
+            return f'{fi.file.relpath}::{fi.cls}.{node.attr}'
+        return None
+
+
+_MODEL_CACHE: dict = {}
+
+
+def thread_model(index: FileIndex) -> ThreadModel:
+    model = _MODEL_CACHE.get(id(index))
+    if model is None or model.index is not index:
+        model = ThreadModel(index)
+        _MODEL_CACHE.clear()
+        _MODEL_CACHE[id(index)] = model
+    return model
