@@ -444,6 +444,132 @@ def _zero_report(step, timeout=240.0):
     return live
 
 
+def _compile_probe_child() -> None:
+    """``--compile-probe``: one JSON line with the compile ledger of a
+    tiny-BERT pjit step built FROM SCRATCH in this process, the compile
+    plane armed over ``BENCH_COMPILE_LEDGER`` and the persistent XLA
+    cache over ``BENCH_COMPILE_CACHE_DIR``. ``_compile_report`` runs it
+    twice against one shared cache dir: the first process pays the full
+    cold XLA backend compile, the second must hit the cache — the
+    process-level cold-vs-warm A/B (a fresh process is the only honest
+    cold start: jax's in-memory caches die with it)."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    prev = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in prev:
+        os.environ['XLA_FLAGS'] = \
+            (prev + ' --xla_force_host_platform_device_count=8').strip()
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import BertForPretraining
+    from mxnet_tpu.models.bert import bert_pretrain_loss
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+    from mxnet_tpu.telemetry import compile as _compile
+
+    _compile.enable()
+    _compile.clear(
+        ledger=os.environ.get('BENCH_COMPILE_LEDGER', ''),
+        cache_dir=os.environ.get('BENCH_COMPILE_CACHE_DIR', ''))
+    cfg = dict(vocab_size=1024, hidden=128, layers=2, heads=4,
+               intermediate=256, max_len=128, type_vocab=2, dropout=0.0)
+    mesh = make_mesh((8,), ('dp',))
+    rng = onp.random.RandomState(0)
+    batch, seq = 8, 64
+    tokens = nd.array(rng.randint(0, cfg['vocab_size'], (batch, seq))
+                      .astype(onp.int32))
+    types = nd.array(onp.zeros((batch, seq), onp.int32))
+    labels = onp.full((batch, seq), -1, onp.int32)
+    labels[:, :8] = rng.randint(0, cfg['vocab_size'], (batch, 8))
+    labels = nd.array(labels)
+    nsp = nd.array(rng.randint(0, 2, batch).astype(onp.int32))
+
+    mx.random.seed(0)
+    # pinned prefix: the gluon auto-naming counter would otherwise bake
+    # a run-dependent param-name set into the lowered module's arg
+    # metadata and churn the XLA cache key between the A/B processes
+    model = BertForPretraining(cfg, prefix='benchc_')
+    model.initialize(mx.init.Normal(0.02))
+    step = ShardedTrainStep(model, bert_pretrain_loss, 'adamw',
+                            {'learning_rate': 1e-4}, mesh=mesh)
+    loss = float(step([tokens, types], [labels, nsp]).asscalar())
+
+    sites = {}
+    for e in _compile.ledger():
+        sites[e['site']] = round(
+            sites.get(e['site'], 0.0) + e['seconds']['total'], 4)
+    ent = [e for e in _compile.ledger()
+           if e['site'] == 'step:train_step']
+    sec = ent[-1]['seconds'] if ent else {}
+    pc = _compile.persistent_cache_stats()
+    rep = {
+        'loss': round(loss, 6),
+        'site_seconds': sites,
+        'step': {k: round(v, 4) for k, v in sec.items()},
+        'cache': {'hits': pc['hits'], 'misses': pc['misses'],
+                  'saved_seconds_est': round(pc['saved_seconds_est'], 4),
+                  'bytes': pc['bytes'], 'files': pc['files']},
+        'ledger_entries': len(_compile.ledger()),
+    }
+    print(json.dumps(rep), flush=True)
+
+
+def _run_compile_probe(cache_dir, ledger, timeout):
+    """One ``--compile-probe`` child sharing cache_dir + ledger; the
+    parsed JSON dict (module-level so the bench contract test can stub
+    the subprocess away)."""
+    env = dict(os.environ, BENCH_COMPILE_CACHE_DIR=cache_dir,
+               BENCH_COMPILE_LEDGER=ledger)
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--compile-probe'],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    for line in reversed((res.stdout or '').strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError(f'no JSON from compile probe '
+                       f'(rc={res.returncode}): {res.stderr[-200:]}')
+
+
+def _compile_report(timeout=240.0):
+    """The ``"compile"`` field (ISSUE 16): the live process's per-site
+    compile seconds from the in-memory ledger (when the plane is
+    armed), plus the cold-vs-warm persistent-cache A/B — two
+    ``--compile-probe`` child processes sharing one XLA cache dir and
+    one on-disk ledger, so the warm child's saved-seconds estimate is
+    priced from the cold child's recorded compile time."""
+    import tempfile
+    from mxnet_tpu.telemetry import compile as _compile
+    out = {'enabled': _compile.enabled(),
+           'ledger_path': _compile.ledger_path() or None}
+    if _compile.enabled():
+        sites = {}
+        for e in _compile.ledger():
+            sites[e['site']] = round(
+                sites.get(e['site'], 0.0) + e['seconds']['total'], 4)
+        out['site_seconds'] = sites
+    # same deadline contract as the zero/resnet reports: each A/B child
+    # gets an equal slice of what's left, and too-little-left skips
+    child_deadline = float(os.environ.get('BENCH_CHILD_DEADLINE', '0'))
+    if child_deadline:
+        timeout = min(timeout, (child_deadline - time.time() - 30) / 2)
+        if timeout < 45:
+            out['cache_ab'] = {'skipped': 'child deadline too close'}
+            return out
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, 'xla_cache')
+        ledger = os.path.join(td, 'ledger.jsonl')
+        cold = _run_compile_probe(cache, ledger, timeout)
+        warm = _run_compile_probe(cache, ledger, timeout)
+    ab = {'cold': cold, 'warm': warm,
+          'warm_hit': bool((warm.get('cache') or {}).get('hits'))}
+    cb = (cold.get('step') or {}).get('backend')
+    wb = (warm.get('step') or {}).get('backend')
+    if cb and wb:
+        ab['backend_speedup'] = round(cb / max(wb, 1e-9), 1)
+    out['cache_ab'] = ab
+    return out
+
+
 def _memory_report(step, run_step, steps=4):
     """The ``"memory"`` field (ISSUE 14): live/peak watermark over a few
     sampled steps (the backend allocator's ``memory_stats`` where it
@@ -827,6 +953,15 @@ def _child(mode: str) -> None:
         out["fleet"] = {"error": repr(e)[:300]}
         _log(f"fleet report failed: {e!r}")
     print(json.dumps(out), flush=True)
+    # compile observability (ISSUE 16): per-site compile seconds + the
+    # cold-vs-warm persistent-cache A/B across two probe processes
+    try:
+        out["compile"] = _compile_report()
+        _log(f"compile report: {out['compile']}")
+    except Exception as e:
+        out["compile"] = {"error": repr(e)[:300]}
+        _log(f"compile report failed: {e!r}")
+    print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -875,6 +1010,9 @@ def _run_child(mode: str, timeout: float):
 def main():
     if len(sys.argv) >= 2 and sys.argv[1] == '--zero-probe':
         _zero_probe_child()
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == '--compile-probe':
+        _compile_probe_child()
         return
     if len(sys.argv) >= 3 and sys.argv[1] == '--child':
         if sys.argv[2] == 'probe':

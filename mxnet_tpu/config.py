@@ -442,3 +442,22 @@ register('MXTPU_ZERO', _zero_stage, 1,
          'backward instead of kept, and grads reduce-scatter straight '
          'into the shard-local update. 0 forces the fully replicated '
          'update.')
+
+register('MXTPU_COMPILE_LEDGER', str, '',
+         'Arm the compile ledger (telemetry.compile): every jit/pjit '
+         'build site appends a structured signature + trace/lower/'
+         'backend-compile timing entry to a bounded in-memory ring and '
+         'an on-disk JSONL ledger. Empty (default): disarmed — build '
+         'sites take a single flag-check fast path. "1"/"on": ledger '
+         'at MXTPU_FLIGHT_DIR/mxtpu_compile_ledger-<pid>.jsonl; any '
+         'other value: an explicit ledger path (share one path across '
+         'processes to estimate persistent-cache saved-seconds from '
+         'prior runs). Validate with tools/check_compile_ledger.py.')
+register('MXTPU_COMPILE_CACHE_DIR', str, '',
+         'Persistent XLA compilation-cache directory, wired through '
+         'jax.config (jax_compilation_cache_dir + the min-entry-size/'
+         'min-compile-time gates dropped to zero so every program is '
+         'eligible). Warm processes reuse cold-process binaries: '
+         'hit/miss/saved-seconds land in mxnet_tpu_compile_persistent_'
+         'cache_* counters and the compile ledger. Empty (default): '
+         "jax's own defaults (cache off unless configured elsewhere).")

@@ -27,6 +27,7 @@ from ..ndarray.ndarray import NDArray, array, _wrap
 from .. import ndarray as nd
 from .. import _imperative
 from .. import random as _random
+from ..telemetry import compile as _compile
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 
@@ -451,19 +452,32 @@ class CachedOp:
                tuple(name for name, _ in params))
         entry = self._cache.get(key)
         compiled_now = False
+        cctx = None
+        site = f"cachedop:{self.block.name}"
         if entry is None:
+            cctx = _compile.begin(site)
             t0 = _time.perf_counter()
-            entry = self._build(params, inputs, state.is_training)
-            if _telem['on']:
+            try:
+                entry = self._build(params, inputs, state.is_training)
+            except BaseException:
+                _compile.abort(cctx)
+                raise
+            if cctx is not None:
+                # the compile ledger takes over the counters: end(cctx)
+                # below feeds record_compile with the structured
+                # signature and the measured trace/lower/backend split
+                _compile.set_signature(
+                    cctx, self._compile_signature(params, inputs))
+                compiled_now = True
+            elif _telem['on']:
                 from .. import telemetry as _telemetry
                 _telemetry.record_compile(
-                    f"cachedop:{self.block.name}", repr(key[0]),
-                    _time.perf_counter() - t0)
+                    site, repr(key[0]), _time.perf_counter() - t0)
                 compiled_now = True
             self._cache[key] = entry
         elif _telem['on']:
             from .. import telemetry as _telemetry
-            _telemetry.record_cache_hit(f"cachedop:{self.block.name}")
+            _telemetry.record_cache_hit(site)
         jitted, aux_names = entry
 
         param_datas = {name: p.data(ctx)._data for name, p in params}
@@ -482,16 +496,22 @@ class CachedOp:
 
         all_inputs = param_arrs + input_arrs
         t0 = _time.perf_counter()
-        out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(
-            run, tuple(all_inputs), {})
+        try:
+            out_data, tensor_inputs, vjp_fn, gfn = _imperative.invoke(
+                run, tuple(all_inputs), {})
+        except BaseException:
+            _compile.abort(cctx)
+            raise
         if compiled_now:
             # _build only traced (jit is lazy): the first execution is
             # where XLA actually lowers and compiles — that is the cost
             # the recompile counters must show, not the trace time
-            from .. import telemetry as _telemetry
-            _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
-                _time.perf_counter() - t0,
-                site=f"cachedop:{self.block.name}")
+            if cctx is not None:
+                _compile.end(cctx)
+            else:
+                from .. import telemetry as _telemetry
+                _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
+                    _time.perf_counter() - t0, site=site)
         n_aux = len(aux_names)
         if n_aux:
             outs_flat, aux = out_data[:-n_aux], out_data[-n_aux:]
@@ -520,6 +540,18 @@ class CachedOp:
         if len(out_arrs) == 1:
             return out_arrs[0]
         return tuple(out_arrs)
+
+    def _compile_signature(self, params, inputs):
+        """Compile-ledger signature of one CachedOp variant: per-input
+        shape/dtype rows plus the mode knobs baked into the cache key."""
+        from ..amp import amp as _amp
+        args = [_compile.array_sig(f'in{i}', x)
+                for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+        return _compile.signature(args=args, flags={
+            'training': bool(state.is_training),
+            'amp_epoch': _amp.patch_epoch(),
+            'params': len(params),
+        })
 
     def _build(self, params, example_inputs, is_training):
         block = self.block

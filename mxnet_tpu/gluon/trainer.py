@@ -14,7 +14,7 @@ from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..resilience import faults as _faults
 from ..telemetry import trace as _trace, flight as _flight, \
-    memory as _memory
+    memory as _memory, compile as _compile
 from .parameter import ParameterDict, Parameter
 
 
@@ -804,7 +804,11 @@ class Trainer:
         for i in indices:
             _flat(updater.states[i], states_flat)
         was_traced = getattr(self, '_fused_traced', False)
+        cctx = None
         if not was_traced:
+            # compile ledger window: eval_shape trace probe + the first
+            # jitted execution below (where XLA lazily compiles)
+            cctx = _compile.begin('trainer:fused_update')
             # probe traceability ABSTRACTLY first: eval_shape consumes no
             # buffers, so a trace failure here can still fall back to the
             # eager loop with every weight/state intact. The real jitted
@@ -817,12 +821,24 @@ class Trainer:
                     w, g, s, a, b, c, wds), weights, grads, states_flat,
                     lrs, ts, rescale)
                 self._fused_traced = True
-                if _telem['on']:
+                if cctx is not None:
+                    _compile.set_signature(cctx, _compile.signature(
+                        args=[_compile.array_sig(f'w{n}', w, donated=True)
+                              for n, w in enumerate(weights[:8])],
+                        flags={'optimizer': opt.__class__.__name__,
+                               'guard': bool(guard_on),
+                               'zero': self._zero_stage
+                               if self._zero_active else 0,
+                               'dp': self._zero_dp,
+                               'params': len(weights),
+                               'state_leaves': len(states_flat)}))
+                elif _telem['on']:
                     from .. import telemetry as _telemetry
                     _telemetry.record_compile(
                         'trainer:fused_update', repr(sig),
                         _time.perf_counter() - t0)
             except Exception:
+                _compile.abort(cctx)
                 from .. import config as _config
                 if _config.get('MXNET_TPU_FUSED_DEBUG'):
                     import traceback
@@ -839,20 +855,27 @@ class Trainer:
                 return False
         import time as _time
         t0 = _time.perf_counter()
-        with _trace.span('optimizer.fused'):
-            out = jitted(weights, grads, states_flat, lrs, ts, rescale,
-                         wds)
+        try:
+            with _trace.span('optimizer.fused'):
+                out = jitted(weights, grads, states_flat, lrs, ts,
+                             rescale, wds)
+        except BaseException:
+            _compile.abort(cctx)
+            raise
         if guard_on:
             new_w, new_s, ok_flag = out
             self._guard.push_flag(ok_flag)
         else:
             new_w, new_s = out
-        if _telem['on'] and not was_traced:
+        if not was_traced:
             # first execution after a (re)trace: jit is lazy, so this is
             # where XLA actually compiles — account it as compile time
-            from .. import telemetry as _telemetry
-            _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
-                _time.perf_counter() - t0, site='trainer:fused_update')
+            if cctx is not None:
+                _compile.end(cctx)
+            elif _telem['on']:
+                from .. import telemetry as _telemetry
+                _telemetry.counter('mxnet_tpu_compile_seconds_total').inc(
+                    _time.perf_counter() - t0, site='trainer:fused_update')
         for (_, _, _, datas), w in zip(items, new_w):
             datas[0]._data = w
         pos = 0

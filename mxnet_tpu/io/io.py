@@ -17,7 +17,8 @@ import numpy as onp
 from ..base import DataError, MXNetError, telem_flags as _telem
 from ..ndarray.ndarray import NDArray, array
 from ..resilience import faults as _faults
-from ..telemetry import trace as _trace, memory as _memory
+from ..telemetry import trace as _trace, memory as _memory, \
+    compile as _compile
 
 
 # ---------------------------------------------------------------------------
@@ -943,8 +944,14 @@ class ImageRecordIter(DataIter):
             if self.transport == 'u8':
                 fn = _device_normalize_fn(
                     self.mean.reshape(3), self.std.reshape(3), self.dtype)
-                with _trace.span('h2d.normalize'):
-                    out = fn(self._batch_data, onp.int32(self._count))
+                batch = self._batch_data
+                with _trace.span('h2d.normalize'), \
+                        _compile.watching('io:normalize', lambda:
+                                          _compile.signature(
+                                              [_compile.array_sig(
+                                                  'u8_nhwc', batch)],
+                                              {'dtype': str(self.dtype)})):
+                    out = fn(batch, onp.int32(self._count))
                 self._lease_consumer = out
                 return [NDArray(out)]
             return [array(self._batch_data)]
@@ -986,7 +993,12 @@ class ImageRecordIter(DataIter):
             self._count_host_bytes(stacked.nbytes)
             fn = _device_normalize_fn(
                 self.mean.reshape(3), self.std.reshape(3), self.dtype)
-            with _trace.span('h2d.normalize'):
+            with _trace.span('h2d.normalize'), \
+                    _compile.watching('io:normalize', lambda:
+                                      _compile.signature(
+                                          [_compile.array_sig(
+                                              'u8_nhwc', stacked)],
+                                          {'dtype': str(self.dtype)})):
                 return [NDArray(fn(stacked, onp.int32(self._count)))]
         out = onp.stack([self._host_normalize(im) for im in batch])
         # pad rows are exact zeros on every path (u8 masks on device)

@@ -64,7 +64,7 @@ from ..base import MXNetError, state as _flags, telem_flags as _telem
 from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
 from ..telemetry import trace as _trace, flight as _flight, \
-    memory as _memory
+    memory as _memory, compile as _compile
 from .. import random as _random
 from . import compression as _compression
 from .collectives import group_params_by_layer, ordered_barrier
@@ -895,11 +895,55 @@ class ShardedTrainStep:
         finally:
             _flags.is_recording = rec
 
-    def __call__(self, inputs, labels, lr=None):
-        with _trace.span('step.dispatch', step=self._step_count):
-            return self._call_traced(inputs, labels, lr)
+    def _build_signature(self, in_datas, lab_datas):
+        """Structured compile-ledger signature of the step program:
+        per-batch-arg shape/dtype (+ the dp batch sharding) and the flag
+        knobs that change the compiled HLO — ZeRO stage, compression
+        codec, guard, donation, mesh layout, parameter count."""
+        batch_spec = None
+        try:
+            batch_spec = str(getattr(self._batch_sh, 'spec',
+                                     self._batch_sh))
+        except Exception:
+            pass
+        args = [_compile.arg_sig(f'data{i}', x.shape, x.dtype,
+                                 sharding=batch_spec,
+                                 donated=False)
+                for i, x in enumerate(in_datas)]
+        args += [_compile.arg_sig(f'label{i}', x.shape, x.dtype,
+                                  sharding=batch_spec, donated=False)
+                 for i, x in enumerate(lab_datas)]
+        try:
+            mesh_shape = {str(k): int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+        except Exception:
+            mesh_shape = None
+        return _compile.signature(args=args, flags={
+            'zero': self._zero_label,
+            'codec': self.compression['type']
+            if self.compression is not None else None,
+            'guard': self._guard is not None,
+            'donate': bool(self.donate),
+            'params': len(self._t_names or ()) + len(self._f_names or ()),
+            'mesh': mesh_shape,
+        })
 
-    def _call_traced(self, inputs, labels, lr=None):
+    def __call__(self, inputs, labels, lr=None):
+        cctx = None
+        if self._compiled is None:
+            # compile ledger: everything from here to the first dispatch
+            # (where jit lazily lowers and backend-compiles) is compile
+            # time, and a stall anywhere inside the window classifies as
+            # COMPILING in the watchdog's stall verdict
+            cctx = _compile.begin('step:train_step')
+        try:
+            with _trace.span('step.dispatch', step=self._step_count):
+                return self._call_traced(inputs, labels, lr, cctx)
+        except BaseException:
+            _compile.abort(cctx)
+            raise
+
+    def _call_traced(self, inputs, labels, lr=None, cctx=None):
         if self._guard is not None:
             # deferred read of the previous step's finiteness flag; a
             # rollback restores params/states/RNG and the post-restore
@@ -942,6 +986,9 @@ class ShardedTrainStep:
                     n: self._opt_init(p.data()._data.astype(jnp.float32))
                     for n, p in trainable}
             self._build(in_datas, lab_datas)
+            if cctx is not None:
+                _compile.set_signature(
+                    cctx, self._build_signature(in_datas, lab_datas))
             # place params on the mesh with their shardings
             with _trace.span('h2d.param_place'), \
                     _memory.oom_guard('h2d.param_place'):
@@ -1022,6 +1069,10 @@ class ShardedTrainStep:
                 t_params, f_params, self._master, self._opt_state,
                 self._residual, in_datas, lab_datas, key, lr_val,
                 fault_scale)
+        if cctx is not None:
+            # the first dispatch returned: XLA's lower + backend compile
+            # are done — close the ledger window before step bookkeeping
+            _compile.end(cctx)
         if self._guard is not None:
             new_t, new_f, new_master, new_state, new_residual, loss, ok \
                 = out

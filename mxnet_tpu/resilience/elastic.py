@@ -128,7 +128,20 @@ def stall_verdict(membership=None):
         membership = _dist.membership()
     if membership is None:
         if not fetching:
-            return None
+            # single-process: no peers to blame, but an open compile
+            # window still classifies the stall — XLA is just slow
+            try:
+                from ..telemetry import compile as _compile
+                fl = _compile.in_flight()
+            except Exception:
+                fl = None
+            if fl is None:
+                return None
+            c = dict(fl)
+            c['rank'] = None
+            return {'verdict': 'compiling', 'peer_ages': {},
+                    'lost': [], 'deadline_seconds': 0.0,
+                    'compiling': c}
         return {'verdict': 'peer_loss_suspected', 'peer_ages': {},
                 'lost': [], 'deadline_seconds': 0.0,
                 'during': 'replica_fetch'}
@@ -166,6 +179,31 @@ def stall_verdict(membership=None):
             v['straggler'] = s
             if v['verdict'] == 'local_stall' and s.get('flagged'):
                 v['verdict'] = 'straggler_suspected'
+    except Exception:
+        pass
+    # compile-window upgrade (ISSUE 16): a rank mid-compile is not
+    # wedged — XLA is just slow. Prefer the LOCAL open window (this
+    # rank is the one compiling), else the straggler's heartbeat-
+    # carried window (rank N is compiling; everyone else is waiting in
+    # a collective on it).
+    try:
+        from ..telemetry import compile as _compile
+        fl = _compile.in_flight()
+        if fl is not None:
+            c = dict(fl)
+            c['rank'] = getattr(membership, 'rank', None)
+            v['compiling'] = c
+            if v['verdict'] == 'local_stall':
+                v['verdict'] = 'compiling'
+        else:
+            s = v.get('straggler')
+            if s and s.get('compiling'):
+                c = dict(s['compiling'])
+                c['rank'] = s.get('rank')
+                v['compiling'] = c
+                if v['verdict'] in ('local_stall',
+                                    'straggler_suspected'):
+                    v['verdict'] = 'compiling'
     except Exception:
         pass
     return v

@@ -166,6 +166,8 @@ class StepWatchdog:
                     note['during'] = verdict['during']
                 if verdict.get('straggler'):
                     note['straggler'] = verdict['straggler']
+                if verdict.get('compiling'):
+                    note['compiling'] = verdict['compiling']
             _flight.note('watchdog.stall', **note)
             path = _flight.dump(reason='watchdog_stall')
             if path:
@@ -243,6 +245,18 @@ class StepWatchdog:
                     f"(last-heartbeat ages per peer: "
                     f"{verdict['peer_ages']}). The fetch itself is "
                     f"bounded by MXTPU_REPLICA_TIMEOUT_SECONDS."))
+            elif verdict.get('verdict') == 'compiling':
+                c = verdict['compiling']
+                rank = c.get('rank')
+                rank_s = rank if rank is not None else 'this process'
+                lines.insert(1, (
+                    f"verdict: COMPILING: rank {rank_s}, site "
+                    f"{c.get('site')}, {c.get('elapsed_seconds')}s "
+                    f"elapsed — an XLA compile (phase "
+                    f"{c.get('phase')}) has the step, not a wedge; "
+                    f"expect it to clear, or persist the cache "
+                    f"(MXTPU_COMPILE_CACHE_DIR) so the next cold start "
+                    f"skips it."))
             elif verdict.get('verdict') == 'straggler_suspected':
                 s = verdict['straggler']
                 lines.insert(1, (

@@ -39,10 +39,11 @@ from .metrics import (  # noqa: F401  (non-__all__ names used by tests/tools)
 from .metrics import __all__ as _metrics_all
 from . import trace          # noqa: F401
 from . import memory         # noqa: F401
+from . import compile        # noqa: F401  (shadows the builtin only here)
 from . import flight         # noqa: F401
 from . import attribution    # noqa: F401
 from . import fleet          # noqa: F401
 from . import server         # noqa: F401
 
-__all__ = list(_metrics_all) + ['trace', 'memory', 'flight',
+__all__ = list(_metrics_all) + ['trace', 'memory', 'compile', 'flight',
                                 'attribution', 'fleet', 'server']

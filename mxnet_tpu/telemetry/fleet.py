@@ -49,6 +49,7 @@ import threading
 import time as _time
 
 from ..base import telem_flags as _telem
+from . import compile as _compile
 from . import flight as _flight
 from . import memory as _memory
 from . import metrics as _metrics
@@ -117,6 +118,12 @@ def local_snapshot():
     mem = _memory.snapshot_fields()
     if mem is not None:
         snap['mem'] = mem
+    # compile plane (MXTPU_COMPILE_LEDGER): cumulative compile seconds
+    # plus the in-flight window — a rank stuck in compile.backend shows
+    # up in every peer's fleet table, not just its own logs
+    comp = _compile.snapshot_fields()
+    if comp is not None:
+        snap['compile'] = comp
     counters = _counter_sums()
     if counters:
         snap['counters'] = counters
@@ -178,7 +185,8 @@ class _RankState:
     __slots__ = ('step', 'wall_ms', 'ewma_ms', 'loss', 'losses',
                  'comm_total', 'comm_rate', 'counters', 'offset',
                  'last_mono', 'last_time', 'snapshots', 'spans_ms',
-                 'flags', 'mem_bytes', 'mem_peak')
+                 'flags', 'mem_bytes', 'mem_peak', 'compile_seconds',
+                 'compiling')
 
     def __init__(self):
         self.step = None
@@ -197,6 +205,8 @@ class _RankState:
         self.flags = set()          # currently-raised anomaly kinds
         self.mem_bytes = None       # live device bytes (memory snapshot)
         self.mem_peak = None
+        self.compile_seconds = None  # cumulative compile wall seconds
+        self.compiling = None        # open compile window, or None
 
 
 class FleetMonitor:
@@ -289,6 +299,15 @@ class FleetMonitor:
                 if mem.get('peak') is not None:
                     st.mem_peak = int(mem['peak'])
                 fired += self._check_memory(now)
+            comp = snap.get('compile')
+            if comp:
+                if comp.get('seconds') is not None:
+                    st.compile_seconds = float(comp['seconds'])
+                # in_flight present = the rank is mid-compile RIGHT NOW;
+                # absent = clear the stale window from the last beat
+                st.compiling = comp.get('in_flight')
+            elif st.compiling is not None:
+                st.compiling = None
             if stepped:
                 dstep = snap['step'] - st.step if st.step is not None \
                     else None
@@ -610,13 +629,18 @@ class FleetMonitor:
         max_step = max(steps) if steps else None
 
         def info(rank, st, reason, flagged):
-            return {
+            out = {
                 'rank': rank, 'reason': reason, 'flagged': flagged,
                 'snapshot_age_seconds': round(now - st.last_mono, 3)
                 if st.last_mono is not None else None,
                 'step': st.step, 'max_step': max_step,
                 'wall_ms': st.wall_ms,
             }
+            if st.compiling:
+                # the rank's own heartbeat says it is mid-compile: the
+                # verdict layer upgrades this straggler to COMPILING
+                out['compiling'] = dict(st.compiling)
+            return out
 
         stale = [(now - st.last_mono, r, st) for r, st in items
                  if 'fleet.stale' in st.flags]

@@ -40,6 +40,7 @@ import threading
 import time as _time
 
 from ..base import telem_flags as _telem
+from . import compile as _compile
 from . import memory as _memory
 from . import trace as _trace
 
@@ -97,6 +98,11 @@ class FlightRecorder:
         mem = _memory.step_fields()
         if mem is not None:
             rec['mem'] = mem
+        # compile-ledger fields: only the first step after a compile
+        # carries them (consume-on-read), same no-alloc discipline
+        comp = _compile.step_fields()
+        if comp is not None:
+            rec['compile'] = comp
         if extra:
             rec.update(extra)
         with self._lock:
@@ -199,6 +205,10 @@ class FlightRecorder:
             'steps': steps,
             'events': events,
             'open_spans': _trace.open_spans(),
+            # the open compile window, when a build is mid-flight at
+            # crash time — a stall INSIDE compile.backend is forensics
+            # gold (which site, which phase, how long)
+            'compile_in_flight': _compile.in_flight(),
             'trace_stats': _trace.stats(),
             'faults_armed': self._armed_faults(),
             'traceEvents': _trace.chrome_events(flush_open=True,

@@ -277,9 +277,11 @@ def reset():
 # recompile detector
 # ---------------------------------------------------------------------------
 
-# site -> {'compiles': int, 'warned': bool}
+# site -> {'compiles': total, 'episode': compiles this churn episode,
+#          'warned': bool, 'mark': _step_mark at the last compile}
 _compile_sites: Dict[str, Dict[str, Any]] = {}
 _recompile_threshold: Optional[int] = None   # None -> read config lazily
+_step_mark = [0]   # bumped by record_step; the recompile detector's clock
 
 
 def set_recompile_threshold(n: Optional[int]):
@@ -296,28 +298,45 @@ def _threshold() -> int:
     return _config.get('MXNET_TPU_RECOMPILE_WARN_THRESHOLD')
 
 
-def record_compile(site: str, signature: str, seconds: float):
+def record_compile(site: str, signature: str, seconds: float,
+                   detail: str = ''):
     """One XLA (re)compilation at `site` for input `signature`.
 
-    Feeds the compile counters and the recompile detector: the first time
-    a site's compile count exceeds the threshold, a RecompileWarning names
-    the churning signature so the shape/dtype instability is actionable.
+    Feeds the compile counters and the recompile detector: when a site's
+    compile count within one churn episode exceeds the threshold, a
+    RecompileWarning names the churning signature (and, when the compile
+    ledger supplies one, the exact churning axis via `detail`) so the
+    shape/dtype instability is actionable.  The latch clears per
+    episode, matching the memory-leak detector's discipline: a site
+    that goes quiet for more than the threshold's worth of training
+    steps (record_step marks) starts a fresh episode and re-fires.
     """
     inc('mxnet_tpu_compile_total', site=site)
     counter('mxnet_tpu_compile_seconds_total').inc(seconds, site=site)
     with _lock:
+        mark = _step_mark[0]
         st = _compile_sites.setdefault(
-            site, {'compiles': 0, 'warned': False})
+            site, {'compiles': 0, 'episode': 0, 'warned': False,
+                   'mark': mark})
+        if mark - st.get('mark', mark) > _threshold():
+            # quiet for > threshold steps since this site's last
+            # compile: the churn episode ended — clear the latch
+            st['warned'] = False
+            st['episode'] = 0
         st['compiles'] += 1
-        fire = st['compiles'] > _threshold() and not st['warned']
+        st['episode'] = st.get('episode', st['compiles'] - 1) + 1
+        st['mark'] = mark
+        fire = st['episode'] > _threshold() and not st['warned']
         if fire:
             st['warned'] = True
             n = st['compiles']
     if fire:
         inc('mxnet_tpu_recompile_warnings_total', site=site)
+        axis = f" Churning axis: {detail}." if detail else ""
         warnings.warn(
             f"telemetry: {site} has compiled {n} times "
-            f"(> threshold {_threshold()}); latest signature: {signature}. "
+            f"(> threshold {_threshold()}); latest signature: {signature}."
+            f"{axis} "
             f"Churning input shapes/dtypes force XLA recompilation every "
             f"step — pad or bucket inputs to a fixed signature.",
             RecompileWarning, stacklevel=3)
@@ -354,6 +373,7 @@ def record_step(seconds: float, samples: int):
     MFU estimate."""
     observe('mxnet_tpu_step_time_seconds', seconds)
     inc('mxnet_tpu_steps_total')
+    _step_mark[0] += 1
     _step_state['last_step_monotonic'] = _time.monotonic()
     if seconds > 0:
         set_gauge('mxnet_tpu_samples_per_second', samples / seconds)

@@ -262,6 +262,13 @@ class TelemetryServer:
         except Exception:
             doc['memory'] = None
         try:
+            # last-compile info + the open compile window + persistent-
+            # cache hit/miss/bytes (cold path, computed on demand)
+            from . import compile as _compile
+            doc['compile'] = _compile.health_fields()
+        except Exception:
+            doc['compile'] = None
+        try:
             from ..checkpoint import last_committed_step
             doc['last_committed_step'] = last_committed_step()
         except Exception:

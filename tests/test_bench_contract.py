@@ -78,6 +78,55 @@ def test_accel_attempt_failure_rides_attempts_failed(bench, capsys,
     assert doc['attempts_failed'] == ['timeout after 540s (mode=auto)']
 
 
+def test_compile_report_contract(bench, monkeypatch):
+    """The "compile" field (ISSUE 16): cold/warm probe children share
+    one cache dir + ledger, the A/B carries warm_hit and the backend
+    speedup — pinned with a stubbed probe so no subprocess (and no jax
+    compile) runs."""
+    calls = []
+
+    def fake_probe(cache_dir, ledger, timeout):
+        calls.append((cache_dir, ledger))
+        cold = not calls[1:]
+        return {
+            'loss': 7.5,
+            'site_seconds': {'step:train_step': 6.1 if cold else 1.4},
+            'step': {'trace': 0.9, 'lower': 0.4,
+                     'backend': 4.8 if cold else 0.25,
+                     'total': 6.1 if cold else 1.4},
+            'cache': ({'hits': 0, 'misses': 17, 'saved_seconds_est': 0.0}
+                      if cold else
+                      {'hits': 17, 'misses': 0,
+                       'saved_seconds_est': 6.1}),
+            'ledger_entries': 1 if cold else 2,
+        }
+
+    monkeypatch.setattr(bench, '_run_compile_probe', fake_probe)
+    monkeypatch.delenv('BENCH_CHILD_DEADLINE', raising=False)
+    rep = bench._compile_report()
+    # both children must share ONE cache dir and ONE ledger file — the
+    # warm process's hit and saved-seconds estimate depend on it
+    assert len(calls) == 2 and calls[0] == calls[1]
+    ab = rep['cache_ab']
+    assert ab['warm_hit'] is True
+    assert ab['backend_speedup'] == round(4.8 / 0.25, 1)
+    assert ab['cold']['cache']['misses'] == 17
+    assert ab['warm']['cache']['saved_seconds_est'] == 6.1
+    assert 'enabled' in rep and 'ledger_path' in rep
+
+
+def test_compile_report_respects_child_deadline(bench, monkeypatch):
+    """Too little left on the child budget: the A/B is skipped, never
+    started — the flagship metric's deadline wins."""
+    def boom(*_a):
+        raise AssertionError("probe must not spawn under a tight deadline")
+    monkeypatch.setattr(bench, '_run_compile_probe', boom)
+    monkeypatch.setenv('BENCH_CHILD_DEADLINE',
+                       str(bench.time.time() + 60))
+    rep = bench._compile_report()
+    assert rep['cache_ab'] == {'skipped': 'child deadline too close'}
+
+
 def test_total_failure_fallback_carries_error(bench, capsys, monkeypatch):
     """Only when NO metric line could be produced does top-level
     "error" appear — and it names the measurement failures, with probe

@@ -1,0 +1,428 @@
+"""Compilation observability (ISSUE 16): the compile ledger's ring +
+on-disk JSONL, recompile forensics that NAME the churning signature
+axis, persistent-cache hit/miss accounting, the COMPILING stall
+verdict, the episode-latched RecompileWarning, and the disarmed
+zero-alloc fast paths (the same bar trace/fleet/memory hold)."""
+import json
+import os
+import threading
+import time
+import tracemalloc
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.telemetry import compile as comp
+from mxnet_tpu.telemetry import flight, metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.set_recompile_threshold(None)
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    comp.disable()
+    comp.clear(ledger='', cache_dir='')
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.set_recompile_threshold(None)
+    trace.disable()
+    trace.clear()
+    flight.get().clear()
+    comp.disable()
+    comp.clear(ledger='', cache_dir='')
+
+
+def _entry(site='t:site', shape=(2, 4), dtype='float32', sharding=None,
+           donated=False, flags=None, name='data'):
+    """One synthetic ledger entry via the real begin/end path."""
+    ctx = comp.begin(site, _span=False)
+    comp.set_signature(ctx, comp.signature(
+        [comp.arg_sig(name, shape, dtype, sharding, donated)], flags))
+    return comp.end(ctx)
+
+
+# ---------------------------------------------------------------------------
+# ring + disarmed fast paths
+# ---------------------------------------------------------------------------
+
+def test_ledger_ring_bounded():
+    comp.enable()
+    comp.clear(ring=8, ledger='')
+    for i in range(30):
+        _entry(shape=(i + 1, 4))
+    ring = comp.ledger()
+    assert len(ring) == 8
+    assert ring[-1]['nth'] == 30          # totals survive the eviction
+    assert [e['signature']['args'][0]['shape'][0] for e in ring] == \
+        list(range(23, 31))
+
+
+def test_disarmed_paths_allocate_nothing():
+    """begin/step_fields/in_flight/watching must cost a flag or dict
+    check and ZERO allocation while the plane is disarmed — they sit on
+    the step dispatch and io normalize hot paths."""
+    comp.disable()
+    assert comp.begin('t:x', _span=False) is None
+    assert comp.end(None) is None
+
+    def hot_loop(n):
+        for _ in range(n):
+            comp.step_fields()
+            comp.in_flight()
+            with comp.watching('t:x'):
+                pass
+
+    hot_loop(64)                          # warm caches
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    hot_loop(2000)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(d.size_diff for d in after.compare_to(before, 'filename')
+                if d.size_diff > 0)
+    assert grown < 4096, f"disarmed compile path leaked {grown} bytes"
+    assert comp.ledger() == []
+
+
+# ---------------------------------------------------------------------------
+# signature diff matrix — the forensics must name the EXACT axis
+# ---------------------------------------------------------------------------
+
+def _sig(shape=(32, 128), dtype='float32', sharding="PartitionSpec('dp',)",
+         donated=False, flags=None, nargs=1):
+    args = [comp.arg_sig('data', shape, dtype, sharding, donated)]
+    for i in range(1, nargs):
+        args.append(comp.arg_sig(f'extra{i}', (4,), 'int32'))
+    return comp.signature(args, flags if flags is not None else {'zero': 1})
+
+
+def test_diff_names_shape_churn():
+    d = comp.diff_signatures(_sig(), _sig(shape=(32, 131)))
+    assert [a['axis'] for a in d] == ['shape']
+    assert d[0]['detail'] == 'arg 0 `data`: shape (32, 128)→(32, 131)'
+
+
+def test_diff_names_dtype_churn():
+    d = comp.diff_signatures(_sig(), _sig(dtype='bfloat16'))
+    assert [a['axis'] for a in d] == ['dtype']
+    assert d[0]['detail'] == 'arg 0 `data`: dtype float32→bfloat16'
+
+
+def test_diff_names_sharding_churn():
+    d = comp.diff_signatures(
+        _sig(), _sig(sharding="PartitionSpec(None,)"))
+    assert [a['axis'] for a in d] == ['sharding']
+    assert d[0]['detail'] == ("arg 0 `data`: sharding "
+                              "PartitionSpec('dp',)→PartitionSpec(None,)")
+
+
+def test_diff_names_donation_churn():
+    d = comp.diff_signatures(_sig(), _sig(donated=True))
+    assert [a['axis'] for a in d] == ['donation']
+    assert d[0]['detail'] == 'arg 0 `data`: donation False→True'
+
+
+def test_diff_names_flag_churn():
+    d = comp.diff_signatures(_sig(), _sig(flags={'zero': 3}))
+    assert [a['axis'] for a in d] == ['flag']
+    assert d[0]['detail'] == 'flag `zero`: 1→3'
+
+
+def test_diff_names_arity_churn():
+    d = comp.diff_signatures(_sig(), _sig(nargs=2))
+    assert d[0]['axis'] == 'arity'
+    assert d[0]['detail'] == 'arg count 1→2'
+    # identical signatures: nothing churns
+    assert comp.diff_signatures(_sig(), _sig()) == []
+
+
+# ---------------------------------------------------------------------------
+# recompile forensics end to end: warning + flight note + metric
+# ---------------------------------------------------------------------------
+
+def test_recompile_forensics_names_axis_everywhere():
+    telemetry.enable()
+    telemetry.set_recompile_threshold(2)
+    trace.enable()                       # flight notes need the ring
+    comp.enable()
+    comp.clear(ledger='')
+    site = 't:forensics'
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        for i in range(4):
+            _entry(site=site, shape=(32, 128 + i))
+    rec = [x for x in w
+           if issubclass(x.category, telemetry.RecompileWarning)]
+    assert len(rec) == 1                 # latched: one warning per episode
+    msg = str(rec[0].message)
+    assert site in msg
+    # fired on the episode's 3rd compile — the axis names THAT churn
+    assert 'Churning axis: arg 0 `data`: shape (32, 129)→(32, 130).' in msg
+    # metric: one increment per churning axis kind per recompile
+    assert telemetry.value('mxnet_tpu_compile_churn_axes', site=site,
+                           axis='shape') == 3
+    # flight note: each recompile names its axes
+    notes = [e for e in flight.get().events()
+             if e['kind'] == 'compile.recompiled']
+    assert len(notes) == 3
+    assert notes[-1]['site'] == site and notes[-1]['nth'] == 4
+    assert notes[-1]['axes'] == ['arg 0 `data`: shape (32, 130)→(32, 131)']
+    # a recompile with an IDENTICAL signature still notes (new program
+    # instance — e.g. a rebuilt step object) and says so
+    _entry(site=site, shape=(32, 131))
+    notes = [e for e in flight.get().events()
+             if e['kind'] == 'compile.recompiled']
+    assert notes[-1]['axes'] == \
+        ['identical signature (new program instance)']
+    # churn ledger entries carry the axis list too
+    assert comp.ledger()[-2]['churn_axes'] == \
+        ['arg 0 `data`: shape (32, 130)→(32, 131)']
+
+
+def test_recompile_warning_relatches_after_quiet_episode():
+    """PR 1's detector latched FOREVER after the first warning; the
+    ledger upgrade clears the latch once the site stays quiet for more
+    than the threshold's worth of training steps — a second churn
+    episode must warn again."""
+    telemetry.enable()
+    telemetry.set_recompile_threshold(2)
+    site = 't:relatch'
+
+    def burst(tag):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            for i in range(4):
+                metrics.record_compile(site, f'{tag}{i}', 0.01)
+        return [x for x in w
+                if issubclass(x.category, telemetry.RecompileWarning)]
+
+    assert len(burst('a')) == 1          # first episode: exactly one
+    # still churning, no quiet period: stays latched
+    assert burst('b') == []
+    # quiet: more steps than the threshold with no compile at the site
+    for _ in range(3):
+        metrics.record_step(0.01, 1)
+    assert len(burst('c')) == 1          # second episode re-fires
+    assert telemetry.value('mxnet_tpu_recompile_warnings_total',
+                           site=site) == 2
+
+
+# ---------------------------------------------------------------------------
+# persistent cache: hit/miss counters + cache_hit note + saved estimate
+# ---------------------------------------------------------------------------
+
+def test_persistent_cache_hits_and_saved_estimate(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    telemetry.enable()
+    trace.enable()
+    comp.enable()
+    comp.clear(ledger=str(tmp_path / 'ledger.jsonl'),
+               cache_dir=str(tmp_path / 'xla_cache'))
+    try:
+        def build(site):
+            # a FRESH closure each call: jax's in-memory jit cache
+            # cannot serve it, so the backend compile (and with it the
+            # persistent cache) runs on every build
+            def f(x):
+                return (x * 3 + 1).sum()
+            ctx = comp.begin(site, _span=False)
+            try:
+                jax.jit(f)(jnp.ones((8, 8))).block_until_ready()
+                comp.set_signature(ctx, comp.signature(
+                    [comp.arg_sig('x', (8, 8), 'float32')]))
+            except BaseException:
+                comp.abort(ctx)
+                raise
+            return comp.end(ctx)
+
+        cold = build('t:pc')
+        assert cold['cache'].get('misses', 0) >= 1
+        assert 'hits' not in cold['cache']
+        warm = build('t:pc')
+        assert warm['cache'].get('hits', 0) >= 1
+        # saved-seconds priced from the ledger's cold compile time (the
+        # jax-reported number can go negative for tiny programs)
+        assert warm['cache']['saved_seconds_est'] == \
+            pytest.approx(cold['seconds']['total'], abs=1e-6)
+        stats = comp.persistent_cache_stats()
+        assert stats['hits'] >= 1 and stats['misses'] >= 1
+        assert stats['bytes'] > 0 and stats['files'] >= 1
+        assert telemetry.value(
+            'mxnet_tpu_compile_persistent_cache_hits_total') >= 1
+        assert telemetry.value(
+            'mxnet_tpu_compile_persistent_cache_misses_total') >= 1
+        notes = [e for e in flight.get().events()
+                 if e['kind'] == 'compile.cache_hit']
+        assert notes and notes[-1]['site'] == 't:pc'
+        assert notes[-1]['saved_seconds_est'] == warm['cache'][
+            'saved_seconds_est']
+    finally:
+        # un-wire the process-global jax cache so later tests' compiles
+        # never write into this test's (deleted) tmp dir
+        jax.config.update('jax_compilation_cache_dir', None)
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc)
+        cc.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# COMPILING stall verdict
+# ---------------------------------------------------------------------------
+
+def test_stall_verdict_compiling_during_hung_first_step(monkeypatch):
+    """An injected step.dispatch:hang lands INSIDE the first step's
+    compile window: the single-process stall verdict classifies the
+    wedge as COMPILING (not a local stall), names the site, and the
+    watchdog report spells out the advice."""
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.resilience.elastic import stall_verdict
+    from mxnet_tpu.resilience.watchdog import StepWatchdog
+    import jax
+
+    monkeypatch.setenv('MXTPU_FAULT_HANG_SECONDS', '3.0')
+    comp.enable()
+    comp.clear(ledger='')
+    assert stall_verdict(None) is None   # nothing in flight, no peers
+
+    mesh = make_mesh((1,), ('dp',), devices=jax.devices()[:1])
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    step = ShardedTrainStep(net, lambda o, l: ((o - l) ** 2).mean(),
+                            'sgd', {'learning_rate': 0.1}, mesh=mesh)
+    x = nd.array(onp.ones((2, 4), onp.float32))
+    y = nd.array(onp.zeros((2, 2), onp.float32))
+    faults.arm('step.dispatch', 'hang')
+    t = threading.Thread(target=lambda: step([x], [y]), daemon=True)
+    try:
+        t.start()
+        v, deadline = None, time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            v = stall_verdict(None)
+            if v is not None and v['verdict'] == 'compiling':
+                break
+            time.sleep(0.02)
+        assert v is not None and v['verdict'] == 'compiling', v
+        c = v['compiling']
+        assert c['site'] == 'step:train_step'
+        assert c['phase'] in ('build', 'trace', 'lower', 'backend')
+        assert c['elapsed_seconds'] >= 0
+        assert c['rank'] is None         # single-process: no rank to name
+        wd = StepWatchdog(deadline_seconds=1.0)
+        report = wd._format_report(2.5, 0, v)
+        assert 'verdict: COMPILING' in report
+        assert 'step:train_step' in report
+        assert 'MXTPU_COMPILE_CACHE_DIR' in report
+    finally:
+        faults.disarm()
+        t.join(timeout=60.0)
+    assert not t.is_alive(), "hung step never completed"
+    # the window closed with the build: verdict clears
+    assert comp.in_flight() is None
+    assert comp.ledger()[-1]['site'] == 'step:train_step'
+
+
+# ---------------------------------------------------------------------------
+# on-disk ledger: atomic writes + validator
+# ---------------------------------------------------------------------------
+
+def test_ledger_append_atomic_survives_kill(tmp_path, monkeypatch):
+    """A crash mid-append (simulated: os.replace dies after the tmp
+    file was written) must leave the PREVIOUS ledger intact and
+    contract-clean — never a truncated hybrid."""
+    led = tmp_path / 'ledger.jsonl'
+    comp.enable()
+    comp.clear(ledger=str(led))
+    _entry(shape=(2, 4))
+    before = led.read_bytes()
+    assert before
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        if str(dst) == str(led):
+            os.unlink(src)               # the "process died" — tmp gone
+            raise OSError('killed mid-replace')
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, 'replace', dying_replace)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        _entry(shape=(3, 4))             # append "dies"
+    assert any('ledger append' in str(x.message) for x in w)
+    monkeypatch.undo()
+    assert led.read_bytes() == before    # old ledger intact, not torn
+
+    _entry(shape=(4, 4))                 # recovery: appends keep working
+    entries = [json.loads(l) for l in
+               led.read_text().splitlines() if l.strip()]
+    assert len(entries) == 2             # the died append is lost, cleanly
+    assert comp.validate_ledger(entries) == []
+
+
+def test_validator_catches_tampering():
+    comp.enable()
+    comp.clear(ledger='')
+    a = _entry(shape=(2, 4))
+    b = _entry(shape=(3, 4))
+    assert comp.validate_ledger([a, b]) == []
+    bad = dict(a, fingerprint='deadbeefdeadbeef')
+    assert any('does not match its signature' in p
+               for p in comp.validate_ledger([bad]))
+    swapped = [dict(b, time=a['time'] + 10), dict(a, time=a['time'])]
+    assert any('went backwards' in p
+               for p in comp.validate_ledger(swapped))
+    assert any('missing key' in p for p in comp.validate_ledger([{
+        'schema': comp.LEDGER_SCHEMA}]))
+
+
+# ---------------------------------------------------------------------------
+# plane integration: flight step fields + healthz + fleet snapshot
+# ---------------------------------------------------------------------------
+
+def test_step_fields_consume_on_read_and_health():
+    comp.enable()
+    comp.clear(ledger='')
+    assert comp.step_fields() is None
+    _entry(site='t:plane', shape=(2, 4))
+    f = comp.step_fields()
+    assert f['site'] == 't:plane' and f['nth'] == 1
+    assert comp.step_fields() is None    # consumed: steady-state quiet
+    h = comp.health_fields()
+    assert h['enabled'] and h['compiles'] == 1
+    assert h['last']['site'] == 't:plane'
+    s = comp.snapshot_fields()
+    assert s['n'] == 1 and s['seconds'] >= 0
+
+
+def test_cachedop_compiles_land_in_ledger():
+    """The gluon CachedOp build site reports through the plane when
+    armed: per-block site name, real phase seconds, churn on a second
+    shape — while the legacy per-site counters stay intact."""
+    telemetry.enable()
+    comp.enable()
+    comp.clear(ledger='')
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 5)))
+    net(nd.ones((4, 5)))                 # second shape: recompile
+    site = f'cachedop:{net.name}'
+    ent = [e for e in comp.ledger() if e['site'] == site]
+    assert len(ent) == 2
+    assert ent[0]['seconds']['total'] > 0
+    assert ent[1]['nth'] == 2
+    assert any(a.startswith('arg 0 `in0`: shape')
+               for a in ent[1]['churn_axes'])
+    # legacy counters fed exactly once per build (no double counting)
+    assert telemetry.value('mxnet_tpu_compile_total', site=site) == 2

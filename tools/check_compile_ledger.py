@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Validate a compile-ledger JSONL file (mxtpu_compile_ledger_v1).
+
+Usage::
+
+    python tools/check_compile_ledger.py LEDGER.jsonl [--quiet]
+
+``LEDGER.jsonl`` is the on-disk ledger ``MXTPU_COMPILE_LEDGER`` names
+(default ``$MXTPU_FLIGHT_DIR/mxtpu_compile_ledger-<pid>.jsonl``): one
+JSON object per line, newest last, written atomically by
+``mxnet_tpu.telemetry.compile``.  Every line is parsed and the whole
+ledger is checked against the schema contract:
+
+- per-entry shape: schema tag, required keys, non-empty site, ``nth``
+  >= 1, non-negative ``seconds.{trace,lower,backend,total}``;
+- the ``fingerprint`` of every entry re-hashes from its ``signature``
+  (a fingerprint that does not match its own signature means the file
+  was hand-edited or torn);
+- timestamps are monotone per writing pid, ``nth`` strictly increases
+  per (pid, site);
+- the same fingerprint never maps to two different signatures.
+
+Exit codes follow ``check_checkpoint_manifest.py``'s ladder so one
+supervisor wrapper drives both:
+
+- **0** — every entry is clean;
+- **2** — the ledger is CORRUPT (unparseable lines or contract
+  violations — the atomic-write convention should make this
+  impossible, so a 2 means hand edits or filesystem damage);
+- **3** — the ledger is MISSING or holds no entries (a process that
+  claims to have compiled must have written at least one line);
+- **1** — argument/usage errors.
+
+The canonical per-entry and whole-ledger validators live in
+``mxnet_tpu.telemetry.compile`` (shared with the in-process ring and
+the dryrun harness); this wrapper only adds file handling + the exit
+ladder.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    from mxtpu_lint import artifacts as _artifacts
+except ImportError:                      # run from the repo root
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxtpu_lint import artifacts as _artifacts
+
+EXIT_CLEAN = _artifacts.EXIT_CLEAN
+EXIT_USAGE = _artifacts.EXIT_USAGE
+EXIT_CORRUPT = _artifacts.EXIT_CORRUPT
+EXIT_MISSING = _artifacts.EXIT_MISSING
+
+
+def _load_validator():
+    """The telemetry.compile module (canonical validators)."""
+    try:
+        from mxnet_tpu.telemetry import compile as _compile
+    except ImportError:                  # run from tools/
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from mxnet_tpu.telemetry import compile as _compile
+    return _compile
+
+
+def check_file(path, quiet=False, out=sys.stdout, err=sys.stderr):
+    """Validate one ledger file; returns the exit code."""
+    if not os.path.isfile(path):
+        print(f"{path}: no such ledger file", file=err)
+        return EXIT_MISSING
+    try:
+        with open(path, encoding='utf-8') as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        print(f"{path}: unreadable ({e})", file=err)
+        return EXIT_MISSING
+    entries = []
+    parse_problems = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            entries.append(json.loads(line))
+        except ValueError as e:
+            parse_problems.append(f'line {i + 1}: not JSON ({e})')
+    if not entries and not parse_problems:
+        print(f"{path}: ledger holds no entries — nothing to vouch for",
+              file=err)
+        return EXIT_MISSING
+    _compile = _load_validator()
+    problems = parse_problems + _compile.validate_ledger(entries)
+    for p in problems:
+        print(f"FAIL {path}: {p}", file=err)
+    if problems:
+        return EXIT_CORRUPT
+    sites = {}
+    for e in entries:
+        sites[e['site']] = sites.get(e['site'], 0) + 1
+    if not quiet:
+        per_site = ', '.join(f'{s} x{n}' for s, n in sorted(sites.items()))
+        print(f"OK   {path}: {len(entries)} entries across "
+              f"{len(sites)} site(s) ({per_site}), all fingerprints "
+              f"verified", file=out)
+    return EXIT_CLEAN
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Validate a compile-ledger JSONL file.')
+    ap.add_argument('path', help='ledger .jsonl file '
+                    '(MXTPU_COMPILE_LEDGER target)')
+    ap.add_argument('--quiet', action='store_true',
+                    help='suppress the OK line (failures still print)')
+    args = ap.parse_args(argv)
+    path = os.path.abspath(args.path)
+    if os.path.isdir(path):
+        print(f"{path}: is a directory, expected a .jsonl ledger file",
+              file=sys.stderr)
+        return EXIT_USAGE
+    return check_file(path, quiet=args.quiet)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -172,6 +172,34 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_memory_leaks_suspected_total': 'counter',
         'mxnet_tpu_memory_oom_dumps_total': 'counter',
     },
+    'mxnet_tpu_compile_': {
+        # compilation observability (ISSUE 16): the per-site compile
+        # counters + the episode-latched recompile detector (PR 1,
+        # upgraded), the gluon CachedOp variant-cache hits, and the
+        # compile ledger's phase split (trace/lower/backend, attributed
+        # via jax.monitoring to the open build site)
+        'mxnet_tpu_compile_total': 'counter',
+        'mxnet_tpu_compile_seconds_total': 'counter',
+        'mxnet_tpu_compile_cache_hits_total': 'counter',
+        'mxnet_tpu_compile_phase_seconds_total': 'counter',
+        # recompile forensics: one increment per churning axis kind
+        # (shape/dtype/sharding/donation/flag/arity, by site) when a
+        # logically-same site recompiles with a different signature
+        'mxnet_tpu_compile_churn_axes': 'counter',
+        # persistent XLA compilation cache (MXTPU_COMPILE_CACHE_DIR):
+        # jax's own hit/miss events, the ledger-estimated cold-compile
+        # seconds a warm process avoided, and the cache dir's on-disk
+        # footprint
+        'mxnet_tpu_compile_persistent_cache_hits_total': 'counter',
+        'mxnet_tpu_compile_persistent_cache_misses_total': 'counter',
+        'mxnet_tpu_compile_persistent_cache_saved_seconds_total':
+            'counter',
+        'mxnet_tpu_compile_persistent_cache_bytes': 'gauge',
+        # ledger bookkeeping: in-memory ring depth + failed JSONL
+        # appends (the ledger must never take down training)
+        'mxnet_tpu_compile_ledger_entries': 'gauge',
+        'mxnet_tpu_compile_ledger_errors_total': 'counter',
+    },
     'mxnet_tpu_checkpoint_': {
         'mxnet_tpu_checkpoint_save_seconds': 'histogram',
         'mxnet_tpu_checkpoint_blocked_seconds': 'histogram',
@@ -239,6 +267,11 @@ SPAN_NAMES = frozenset({
     'sync.lease_drain',
     # resilience
     'guard.rollback', 'elastic.reform',
+    # compilation observability (ISSUE 16): the build-site window span
+    # plus the jax.monitoring-attributed phase spans (emitted
+    # interpolated as f'compile.{phase}' — the static rule checks
+    # literals, the phase set is declared here)
+    'compile.build', 'compile.trace', 'compile.lower', 'compile.backend',
 })
 
 # ---------------------------------------------------------------------------
@@ -270,6 +303,10 @@ FLIGHT_NOTE_NAMES = frozenset({
     # note, the OOM forensics dump marker, and the coordinator-side
     # per-rank HBM-imbalance flag
     'memory.leak_suspected', 'memory.oom', 'fleet.memory_imbalance',
+    # compilation observability (ISSUE 16): the recompile-forensics
+    # note naming the churning signature axis, and the persistent-cache
+    # hit marker with ledger-estimated saved seconds
+    'compile.recompiled', 'compile.cache_hit',
 })
 
 # ---------------------------------------------------------------------------
