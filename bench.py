@@ -483,10 +483,10 @@ def _compile_probe_child() -> None:
     nsp = nd.array(rng.randint(0, 2, batch).astype(onp.int32))
 
     mx.random.seed(0)
-    # pinned prefix: the gluon auto-naming counter would otherwise bake
-    # a run-dependent param-name set into the lowered module's arg
-    # metadata and churn the XLA cache key between the A/B processes
-    model = BertForPretraining(cfg, prefix='benchc_')
+    # auto-named: the step jit boundary is name-stable (positional
+    # token aliases), so A/B processes share cache entries regardless
+    # of where the gluon naming counter sits
+    model = BertForPretraining(cfg)
     model.initialize(mx.init.Normal(0.02))
     step = ShardedTrainStep(model, bert_pretrain_loss, 'adamw',
                             {'learning_rate': 1e-4}, mesh=mesh)
@@ -567,6 +567,128 @@ def _compile_report(timeout=240.0):
     if cb and wb:
         ab['backend_speedup'] = round(cb / max(wb, 1e-9), 1)
     out['cache_ab'] = ab
+    return out
+
+
+def _serving_report(requests=60, deadlines=(0.0, 2.0, 8.0),
+                    fleet_timeout=180.0):
+    """The ``"serving"`` field (ISSUE 17): measured predict QPS and
+    p50/p99 latency vs the batch-formation deadline on one replica
+    (same compiled programs across the sweep — the engines share one
+    warmed runner), an int8-quantized A/B on the same traffic, and the
+    two-replica fleet drill's numbers (failover storm QPS, drain MTTR,
+    cold-vs-warm AOT warmup seconds)."""
+    import tempfile
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.telemetry import compile as _compile
+
+    _compile.enable()     # the warmup report's compile count reads it
+
+    class _Tok(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(64, 32)
+                self.proj = nn.Dense(8, flatten=False)
+
+        def forward(self, x):
+            return self.proj(self.embed(x))
+
+    def _storm(engine, seqs):
+        errs = []
+
+        def client(seq):
+            try:
+                engine.submit(seq, timeout=60.0)
+            except Exception as e:                    # noqa: BLE001
+                errs.append(repr(e))
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in seqs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+        st = engine.stats()
+        return {'qps': round(len(seqs) / max(wall, 1e-9), 1),
+                'p50_ms': st['p50_ms'], 'p99_ms': st['p99_ms'],
+                'batches': st['batches'],
+                'fill': round(len(seqs) / max(st['batches'], 1), 2),
+                'errors': errs[:3]}
+
+    rng = onp.random.RandomState(11)
+    seqs = [[int(v) for v in rng.randint(0, 64, rng.randint(1, 33))]
+            for _ in range(requests)]
+    net = _Tok()
+    net.initialize()
+    runner = serving.BlockRunner(net)
+    out = {'requests': requests, 'seq_buckets': [16, 32],
+           'batch_buckets': [1, 2, 4, 8]}
+    sweep = {}
+    for i, dl in enumerate(deadlines):
+        eng = serving.InferenceEngine(
+            runner, seq_buckets='16,32', batch_buckets='1,2,4,8',
+            deadline_ms=dl)
+        if i == 0:
+            # one warmup covers the whole sweep: every engine rides the
+            # same block's CachedOp programs
+            warm = serving.warmup(eng)
+            out['warmup'] = {'total_seconds': warm['total_seconds'],
+                             'compiles': warm['compiles']}
+        sweep[f'{dl:g}ms'] = _storm(eng, seqs)
+        eng.drain()
+    out['deadline_sweep'] = sweep
+    # int8 weights A/B on the same traffic (PR 11 codec grid): the
+    # latency delta and the worst-case output drift on a fixed probe
+    probe = [1, 2, 3, 5, 7]
+    base = onp.asarray(runner(onp.asarray(
+        [probe + [0] * 11], 'int32')))[0, :5]
+    qnet = _Tok()
+    qnet.initialize()
+    qnet(nd.array(onp.zeros((1, 16), 'int32')))
+    fd, tmp = tempfile.mkstemp(suffix='.params')
+    os.close(fd)
+    try:
+        net.save_parameters(tmp)
+        qnet.load_parameters(tmp)
+    finally:
+        os.unlink(tmp)
+    serving.quantize_weights(qnet, 'int8')
+    qrunner = serving.BlockRunner(qnet)
+    qeng = serving.InferenceEngine(qrunner, seq_buckets='16,32',
+                                   batch_buckets='1,2,4,8',
+                                   deadline_ms=2.0)
+    serving.warmup(qeng)
+    qab = _storm(qeng, seqs)
+    qeng.drain()
+    qout = onp.asarray(qrunner(onp.asarray(
+        [probe + [0] * 11], 'int32')))[0, :5]
+    qab['max_output_drift'] = round(
+        float(onp.max(onp.abs(qout - base))), 5)
+    out['int8_ab'] = qab
+    # the fleet half: 2 replica processes + router, SIGTERM mid-storm
+    child_deadline = float(os.environ.get('BENCH_CHILD_DEADLINE', '0'))
+    if child_deadline and child_deadline - time.time() < 90:
+        out['fleet'] = {'skipped': 'child deadline too close'}
+        return out
+    from mxnet_tpu.resilience.drill import run_serving_drill
+    with tempfile.TemporaryDirectory() as td:
+        drill = run_serving_drill(td, timeout=fleet_timeout)
+    out['fleet'] = {
+        'requests': drill['requests'], 'failed': drill['failed'],
+        'failovers': drill['failovers'],
+        'mttr_seconds': drill['mttr_seconds'],
+        'warmup_cold_seconds': drill['warmup'][1]['total_seconds'],
+        'warmup_warm_seconds': drill['warmup'][2]['total_seconds'],
+        'warm_cache_hits': drill['warmup'][2]['cache']['hits'],
+        'p50_ms': {r: s['p50_ms'] for r, s in drill['stats'].items()},
+    }
     return out
 
 
@@ -961,6 +1083,15 @@ def _child(mode: str) -> None:
     except Exception as e:
         out["compile"] = {"error": repr(e)[:300]}
         _log(f"compile report failed: {e!r}")
+    print(json.dumps(out), flush=True)
+    # inference serving (ISSUE 17): predict QPS + p50/p99 vs the batch
+    # deadline, int8 A/B, and the two-replica failover drill
+    try:
+        out["serving"] = _serving_report()
+        _log(f"serving report: {out['serving']}")
+    except Exception as e:
+        out["serving"] = {"error": repr(e)[:300]}
+        _log(f"serving report failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 

@@ -461,3 +461,58 @@ register('MXTPU_COMPILE_CACHE_DIR', str, '',
          'hit/miss/saved-seconds land in mxnet_tpu_compile_persistent_'
          'cache_* counters and the compile ledger. Empty (default): '
          "jax's own defaults (cache off unless configured elsewhere).")
+
+# -- inference serving (mxnet_tpu.serving) ---------------------------------
+
+register('MXTPU_SERVE_BATCH_DEADLINE_MS', float, 5.0,
+         'Continuous-batcher formation deadline: a batch dispatches '
+         'when its sequence bucket fills to the largest batch bucket '
+         'or when its OLDEST request has waited this long, whichever '
+         'comes first. 0 dispatches immediately (lowest p50, worst '
+         'device efficiency); larger values trade queue latency for '
+         'fuller batches.')
+register('MXTPU_SERVE_BUCKETS', str, '32,64,128',
+         'Sequence-length buckets (comma-separated, ascending). Every '
+         'request pads up to the smallest bucket that fits; requests '
+         'longer than the largest bucket are rejected with 400. '
+         'Together with MXTPU_SERVE_BATCH_BUCKETS this fixes the '
+         'compiled-shape universe the warmup pass pre-builds — steady '
+         'state never compiles.')
+register('MXTPU_SERVE_BATCH_BUCKETS', str, '1,2,4,8',
+         'Batch-size buckets (comma-separated, ascending). A formed '
+         'batch pads its row count up to the smallest bucket that '
+         'fits; the largest bucket is the fill target that dispatches '
+         'a batch early.')
+register('MXTPU_SERVE_QUEUE_LIMIT', int, 256,
+         'Admission bound on total queued predict requests; beyond it '
+         'submissions shed with 503 (mxnet_tpu_serving_shed_total, '
+         'reason=queue_full) instead of growing an unbounded backlog.')
+register('MXTPU_SERVE_PORT', int, 0,
+         'Predict-endpoint base port (rank r serves on base + r, the '
+         'same collision-avoidance scheme as MXTPU_METRICS_PORT). '
+         '0 = serving disarmed.')
+register('MXTPU_SERVE_QUANTIZE', str, '',
+         "Weight quantization for the predict path: '' (default, "
+         "full precision), 'bf16' (cast parameters to bfloat16 — 2x "
+         "residency), or 'int8' (snap float weights to the PR 11 "
+         "codec's block-scaled int8 value grid — the accuracy of an "
+         'int8-weights deployment, stored in float on this backend).')
+register('MXTPU_SERVE_MEMORY_LIMIT_MB', float, 0.0,
+         'Admission control from memory observability: when live '
+         'device bytes (telemetry.memory.health_fields) exceed this, '
+         'predicts shed with 503 until pressure clears. 0 = off.')
+register('MXTPU_SERVE_WATCHDOG_SECONDS', float, 0.0,
+         'Arm a StepWatchdog over the batcher: a dispatch that '
+         'produces no completed batch for this long dumps a stall '
+         'report (classified COMPILING vs EXECUTING via the compile '
+         'window) and notes serving.stuck. 0 = off.')
+register('MXTPU_SERVE_EJECT_FAILURES', int, 2,
+         'Router ejection threshold: this many CONSECUTIVE failed '
+         'predicts (connect refused, 5xx, shed) ejects a replica from '
+         'rotation for MXTPU_SERVE_READMIT_SECONDS.')
+register('MXTPU_SERVE_READMIT_SECONDS', float, 5.0,
+         'How long an ejected replica sits out before the router '
+         'probes it back in (the next routed predict is the probe).')
+register('MXTPU_SERVE_DRAIN_SECONDS', float, 10.0,
+         'Graceful-drain budget: how long a draining replica waits '
+         'for in-flight requests to flush before closing.')

@@ -480,8 +480,6 @@ class CachedOp:
             _telemetry.record_cache_hit(site)
         jitted, aux_names = entry
 
-        param_datas = {name: p.data(ctx)._data for name, p in params}
-        input_datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
         rng = _random.next_key()
 
         # one taped node for the whole compiled call
@@ -490,8 +488,7 @@ class CachedOp:
 
         def run(*datas):
             n = len(params)
-            pd = {name: d for (name, _), d in zip(params, datas[:n])}
-            outs, aux = jitted(pd, list(datas[n:]), rng)
+            outs, aux = jitted(list(datas[:n]), list(datas[n:]), rng)
             return tuple(outs) + tuple(aux)
 
         all_inputs = param_arrs + input_arrs
@@ -557,10 +554,15 @@ class CachedOp:
         block = self.block
         aux_names_holder = []
 
+        # param_datas is a positional LIST (sorted-name order), not a
+        # name-keyed dict: dict keys land in the lowered module's arg
+        # metadata, and gluon's auto-naming counter (dense0_, dense3_,
+        # ...) would churn the persistent XLA cache key across processes
+        # for structurally identical blocks. Names stay in this closure.
         def fn(param_datas, input_datas, rng):
             proxies = {}
-            for name, p in params:
-                proxies[name] = NDArray(param_datas[name])
+            for (name, p), data in zip(params, param_datas):
+                proxies[name] = NDArray(data)
                 p._set_trace_proxy(proxies[name])
             orig_ids = {name: id(proxies[name]._data) for name, _ in params}
             wrapped = []
@@ -597,7 +599,7 @@ class CachedOp:
         jitted = jax.jit(fn)
         # trace once now to discover aux names (jit caches the trace)
         ctx = None
-        param_datas = {name: p.data(ctx)._data for name, p in params}
+        param_datas = [p.data(ctx)._data for _, p in params]
         input_datas = [x._data for x in example_inputs if isinstance(x, NDArray)]
         rng = jax.random.PRNGKey(0)
         _ = jax.eval_shape(jitted, param_datas, input_datas, rng)

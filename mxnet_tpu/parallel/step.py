@@ -371,6 +371,8 @@ class ShardedTrainStep:
         self._opt_state = None
         self._residual = None     # error-feedback residuals (compression)
         self._compiled = None
+        self._alias = None        # name-stable jit-boundary key aliases
+        self._alias_rev = None
         self._step_count = 0
         self._pending_states = None   # restored blob awaiting first build
         self._cost_args = None        # avals for cost_analysis()
@@ -762,17 +764,48 @@ class ShardedTrainStep:
                         new_residual, loss_val, ok)
             return (new_params, new_f, new_master, new_state,
                     new_residual, loss_val)
-        in_shardings = (t_shardings, f_shardings, master_shardings,
-                        state_shardings, residual_shardings,
+        # Name-stable jit boundary: the pytree dict keys of every param
+        # container land in the lowered module's arg metadata and hence
+        # the persistent XLA cache key. gluon's auto-naming counter
+        # (bertforpretraining0_, ...3_, ...) would churn that key across
+        # processes for structurally identical models, so each name is
+        # aliased to a positional token derived from sorted order —
+        # identical relative order for any two models differing only in
+        # prefix — and the real names never cross into the traced
+        # program. ``_alias_enc``/``_alias_dec`` translate at the call
+        # site; the jitted function holds the reverse map in closure.
+        alias = {n: f'p{i:04d}'
+                 for i, n in enumerate(sorted(set(t_names) | set(f_names)))}
+        rev = {t: n for n, t in alias.items()}
+        self._alias, self._alias_rev = alias, rev
+
+        def _enc(d):
+            return {alias[n]: v for n, v in d.items()}
+
+        def _dec(d):
+            return {rev[t]: v for t, v in d.items()}
+
+        def stable_step(t_params, f_params, master, opt_state, residual,
+                        inputs, labels, key, lr, fault_scale):
+            out = train_step(_dec(t_params), _dec(f_params), _dec(master),
+                             _dec(opt_state), _dec(residual),
+                             inputs, labels, key, lr, fault_scale)
+            return tuple(_enc(o) if isinstance(o, dict) else o
+                         for o in out)
+
+        in_shardings = (_enc(t_shardings), _enc(f_shardings),
+                        _enc(master_shardings), _enc(state_shardings),
+                        _enc(residual_shardings),
                         tuple(batch_sh for _ in example_inputs),
                         tuple(batch_sh for _ in example_labels),
                         repl, repl, repl)
-        out_shardings = (t_shardings, f_shardings, master_shardings,
-                         state_shardings, residual_shardings, repl)
+        out_shardings = (_enc(t_shardings), _enc(f_shardings),
+                         _enc(master_shardings), _enc(state_shardings),
+                         _enc(residual_shardings), repl)
         if guard_on:
             out_shardings = out_shardings + (repl,)
         donate = (0, 2, 3, 4) if self.donate else ()
-        self._compiled = jax.jit(train_step, in_shardings=in_shardings,
+        self._compiled = jax.jit(stable_step, in_shardings=in_shardings,
                                  out_shardings=out_shardings,
                                  donate_argnums=donate)
         self._master_names = master_names
@@ -895,6 +928,17 @@ class ShardedTrainStep:
         finally:
             _flags.is_recording = rec
 
+    def _alias_enc(self, d):
+        """Real-name dict -> positional-token dict (the compiled step's
+        name-stable pytree keys; see the aliasing note in _build)."""
+        a = self._alias
+        return {a[n]: v for n, v in d.items()}
+
+    def _alias_dec(self, d):
+        """Positional-token dict -> real-name dict."""
+        r = self._alias_rev
+        return {r[t]: v for t, v in d.items()}
+
     def _build_signature(self, in_datas, lab_datas):
         """Structured compile-ledger signature of the step program:
         per-batch-arg shape/dtype (+ the dp batch sharding) and the flag
@@ -930,14 +974,18 @@ class ShardedTrainStep:
 
     def __call__(self, inputs, labels, lr=None):
         cctx = None
-        if self._compiled is None:
-            # compile ledger: everything from here to the first dispatch
-            # (where jit lazily lowers and backend-compiles) is compile
-            # time, and a stall anywhere inside the window classifies as
-            # COMPILING in the watchdog's stall verdict
-            cctx = _compile.begin('step:train_step')
         try:
             with _trace.span('step.dispatch', step=self._step_count):
+                if self._compiled is None:
+                    # compile ledger: everything from here to the first
+                    # dispatch (where jit lazily lowers and
+                    # backend-compiles) is compile time, and a stall
+                    # anywhere inside the window classifies as COMPILING
+                    # in the watchdog's stall verdict. Opened INSIDE the
+                    # step.dispatch span: both sides end in-span, and a
+                    # window straddling the span boundary corrupts the
+                    # chrome B/E nesting.
+                    cctx = _compile.begin('step:train_step')
                 return self._call_traced(inputs, labels, lr, cctx)
         except BaseException:
             _compile.abort(cctx)
@@ -1045,8 +1093,13 @@ class ShardedTrainStep:
                             'mxnet_tpu_comm_compression_ratio',
                             cp['raw_bytes'] / cp['encoded_bytes'])
 
-        t_params = {n: p.data()._data for n, p in self._trainable}
-        f_params = {n: p.data()._data for n, p in self._frozen}
+        t_params = self._alias_enc(
+            {n: p.data()._data for n, p in self._trainable})
+        f_params = self._alias_enc(
+            {n: p.data()._data for n, p in self._frozen})
+        master = self._alias_enc(self._master)
+        opt_state = self._alias_enc(self._opt_state)
+        residual = self._alias_enc(self._residual)
         key = _random.next_key()
         lr_val = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
         with _trace.span('h2d.batch_put'), \
@@ -1060,15 +1113,13 @@ class ShardedTrainStep:
             self._cost_args = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
                                                jnp.result_type(x)),
-                (t_params, f_params, self._master, self._opt_state,
-                 self._residual, in_datas, lab_datas, key, lr_val,
-                 fault_scale))
+                (t_params, f_params, master, opt_state, residual,
+                 in_datas, lab_datas, key, lr_val, fault_scale))
         with _trace.span('step.compiled'), \
                 _memory.oom_guard('step.dispatch'):
             out = self._compiled(
-                t_params, f_params, self._master, self._opt_state,
-                self._residual, in_datas, lab_datas, key, lr_val,
-                fault_scale)
+                t_params, f_params, master, opt_state, residual,
+                in_datas, lab_datas, key, lr_val, fault_scale)
         if cctx is not None:
             # the first dispatch returned: XLA's lower + backend compile
             # are done — close the ledger window before step bookkeeping
@@ -1079,6 +1130,10 @@ class ShardedTrainStep:
             self._guard.push_flag(ok)
         else:
             new_t, new_f, new_master, new_state, new_residual, loss = out
+        new_t, new_f = self._alias_dec(new_t), self._alias_dec(new_f)
+        new_master = self._alias_dec(new_master)
+        new_state = self._alias_dec(new_state)
+        new_residual = self._alias_dec(new_residual)
         with _trace.span('step.gather'):
             # donate/gather bookkeeping: swap the donated buffers'
             # NDArray views to the program's outputs (host pointer

@@ -37,7 +37,8 @@ import subprocess
 import sys
 import time as _time
 
-__all__ = ['run_drill', 'run_fleet_drill', 'run_oom_drill']
+__all__ = ['run_drill', 'run_fleet_drill', 'run_oom_drill',
+           'run_serving_drill']
 
 
 def _free_port():
@@ -820,10 +821,318 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
     }
 
 
+def _serve_model():
+    """The drill's serving model: tiny token-in/logits-out block. Every
+    process builds it identically (auto-named — the jit boundary is
+    name-stable, PR 17 satellite), so a checkpoint pushed from one
+    process loads into another's block by parameter name."""
+    from mxnet_tpu.gluon import nn
+
+    class TinyTok(nn.HybridBlock):
+        def __init__(self, vocab=64, dim=8, classes=4, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, dim)
+                self.proj = nn.Dense(classes, flatten=False)
+
+        def forward(self, x):
+            return self.proj(self.embed(x))
+
+    net = TinyTok()
+    net.initialize()
+    return net
+
+
+_SERVE_WORLD = 3      # rank 0 = the router/observer, ranks 1..2 serve
+
+
+def _serving_worker(args):
+    """One serving replica of the drain drill: membership rank
+    ``args.rank`` of a 3-rank view (rank 0 is the parent's router),
+    warmup through the SHARED persistent compile cache, then a
+    PredictServer + hosted ReplicaServer until drained (SIGTERM or
+    POST /drain)."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    from mxnet_tpu import serving
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.telemetry import compile as _compile
+
+    rank = args.rank
+    _compile.enable()
+    ms = dist.Membership(rank, _SERVE_WORLD, port=args.port,
+                         heartbeat_seconds=args.heartbeat,
+                         deadline_seconds=args.deadline)
+    net = _serve_model()
+    engine = serving.InferenceEngine(
+        serving.BlockRunner(net), seq_buckets='8,16',
+        batch_buckets='1,2,4', deadline_ms=2.0)
+    warm = serving.warmup(engine)
+    ledger_after_warmup = len(_compile.ledger())
+    store = os.path.join(args.workdir, f'store-rank{rank}')
+    rs = dist.ReplicaServer(store, port=args.replica_base + rank)
+    srv = serving.PredictServer(engine, port=args.serve_base + rank,
+                                membership=ms, block=net,
+                                replica_root=store)
+    srv.install_sigterm()
+    ready = {'rank': rank, 'serve_port': srv.port,
+             'replica_port': args.replica_base + rank, 'warmup': warm}
+    _atomic_json(os.path.join(args.workdir, f'ready-rank{rank}.json'),
+                 ready)
+    while not srv.draining.is_set():
+        _time.sleep(0.05)
+    # drain() flushed the engine + left the membership; wait for the
+    # listener to retire (drain's final stop()) then report and exit
+    deadline = _time.monotonic() + 30.0
+    while srv._server is not None and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    out = {'rank': rank, 'stats': engine.stats(),
+           'ledger_after_warmup': ledger_after_warmup,
+           'ledger_final': len(_compile.ledger()),
+           'reloaded_step': srv.reloaded_step}
+    _atomic_json(os.path.join(args.workdir, f'result-rank{rank}.json'),
+                 out)
+    rs.stop()
+    ms.stop()
+
+
+def _atomic_json(path, doc):
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(doc, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+def run_serving_drill(workdir, requests=90, kill_rank=1, heartbeat=0.1,
+                      deadline=2.0, timeout=180.0):
+    """Two-replica serving drain drill (ISSUE 17).
+
+    Spawns 2 replica processes (membership ranks 1..2; this process is
+    rank 0, the router's observer seat) sharing one persistent compile
+    cache dir, storms the fleet through the ``serving.Router``, and
+    ``SIGTERM``s rank ``kill_rank`` mid-storm. Asserts:
+
+    - both replicas warmed their full bucket grid before the first
+      request (and the SECOND replica's warmup rode the first's
+      persistent cache);
+    - the storm finishes with **zero failed requests** — predicts that
+      hit the dying replica fail over inside the router;
+    - zero steady-state recompiles on the survivor (compile ledger is
+      flat after warmup);
+    - the drained replica LEAVES the membership (a departure, not a
+      loss) and the router's set drops it — MTTR is measured from the
+      SIGTERM to the router no longer holding the dead rank;
+    - a weight push (replica transport + POST /reload) lands on the
+      survivor and its predictions flip to the pushed weights exactly.
+
+    Returns the measured numbers for PERF_NOTES / dryrun_multichip."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.parallel import dist
+
+    os.makedirs(workdir, exist_ok=True)
+    side_port = _free_port()
+    serve_base = _free_port_base(_SERVE_WORLD)
+    replica_base = _free_port_base(_SERVE_WORLD)
+    cache_dir = os.path.join(workdir, 'xla_cache')
+    env = dict(os.environ)
+    env.update({
+        'PYTHONPATH': os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))] +
+            ([env['PYTHONPATH']] if env.get('PYTHONPATH') else [])),
+        'JAX_PLATFORMS': 'cpu',
+        'MXNET_TPU_TELEMETRY': '1',
+        'MXTPU_COMPILE_CACHE_DIR': cache_dir,
+        'MXTPU_FLIGHT_DIR': workdir,
+    })
+    ms = dist.Membership(0, _SERVE_WORLD, port=side_port,
+                         heartbeat_seconds=heartbeat,
+                         deadline_seconds=deadline)
+    base = [sys.executable, '-m', 'mxnet_tpu.resilience.drill',
+            '--serve', '--workdir', workdir, '--port', str(side_port),
+            '--serve-base', str(serve_base),
+            '--replica-base', str(replica_base),
+            '--heartbeat', str(heartbeat), '--deadline', str(deadline)]
+    procs, logs = {}, []
+
+    def _spawn(r):
+        log = open(os.path.join(workdir, f'serve-rank{r}.log'), 'wb')
+        logs.append(log)
+        procs[r] = subprocess.Popen(base + ['--rank', str(r)], env=env,
+                                    stdout=log, stderr=subprocess.STDOUT)
+
+    def _fail(msg):
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        errs = []
+        for log in logs:
+            log.flush()
+            try:
+                with open(log.name, 'rb') as f:
+                    errs.append(f"-- {log.name} --\n" +
+                                f.read().decode(errors='replace')[-3000:])
+            except OSError:
+                pass
+        raise AssertionError(msg + '\n' + '\n'.join(errs))
+
+    def _wait_ready(ready, r, t0):
+        while _time.monotonic() - t0 < timeout and r not in ready:
+            p = os.path.join(workdir, f'ready-rank{r}.json')
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        ready[r] = json.load(f)
+                    break
+                except (OSError, ValueError):
+                    pass
+            if procs[r].poll() is not None:
+                _fail(f"serving drill: rank {r} died before ready")
+            _time.sleep(0.05)
+        if r not in ready:
+            _fail(f"serving drill: rank {r} never finished warmup")
+
+    try:
+        # replica 1 warms COLD (pays every XLA compile into the shared
+        # cache dir), then replica 2 starts and warms WARM — the
+        # persistent-cache startup win, measured
+        ready, t0 = {}, _time.monotonic()
+        _spawn(1)
+        _wait_ready(ready, 1, t0)
+        _spawn(2)
+        _wait_ready(ready, 2, t0)
+        for r in (1, 2):
+            assert ready[r]['warmup']['buckets'], ready[r]
+        assert ready[2]['warmup']['cache']['hits'] > 0, \
+            f"warm replica never hit the persistent cache: {ready[2]}"
+        survivor = 3 - kill_rank
+
+        # storm through the router; SIGTERM kill_rank a third in
+        router = serving.Router(membership=ms, serve_port_base=serve_base,
+                                timeout=30.0)
+        rng = onp.random.RandomState(7)
+        storm = [[int(v) for v in rng.randint(0, 64, rng.randint(1, 17))]
+                 for _ in range(requests)]
+        failures, t_kill = [], [None]
+        lock = threading.Lock()
+
+        def _client(i, seq):
+            if i == requests // 3 and t_kill[0] is None:
+                with lock:
+                    if t_kill[0] is None:
+                        t_kill[0] = _time.monotonic()
+                        procs[kill_rank].send_signal(signal.SIGTERM)
+            try:
+                out = router.predict(seq)
+                assert len(out) == len(seq), (len(out), len(seq))
+            except Exception as e:                    # noqa: BLE001
+                failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=_client, args=(i, s))
+                   for i, s in enumerate(storm)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i % 8 == 7:
+                _time.sleep(0.02)      # a storm, not one thundering herd
+        for t in threads:
+            t.join(timeout=60)
+        assert not failures, \
+            f"{len(failures)} predicts failed: {failures[:5]}"
+        assert t_kill[0] is not None, "the kill point never fired"
+
+        # MTTR: SIGTERM -> router no longer holds the drained rank
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 30.0:
+            router.refresh()
+            with router._lock:
+                gone = kill_rank not in router._replicas
+            if gone:
+                break
+            _time.sleep(0.02)
+        assert gone, "router never dropped the drained replica"
+        mttr = _time.monotonic() - t_kill[0]
+        view = ms.view()
+        assert kill_rank in (view.get('left') or []), \
+            f"drained rank should be a DEPARTURE, view={view}"
+
+        # weight push: new weights reach the survivor over the replica
+        # transport and flip its predictions exactly
+        net = _serve_model()
+        probe = [1, 2, 3, 5, 7]
+        want = onp.asarray(net(nd.array(
+            onp.asarray([probe + [0] * 3], 'int32'))).asnumpy())[0, :5]
+        push = serving.push_weights(
+            net, step=7,
+            replicas=[{'host': '127.0.0.1',
+                       'replica_port': replica_base + survivor,
+                       'serve_port': serve_base + survivor}])
+        res = push[serve_base + survivor]
+        assert res.get('status') == 200, push
+        got = onp.asarray(router.predict(probe), onp.float64)
+        assert onp.allclose(got, want, atol=1e-5), (got, want)
+
+        # graceful drain of the survivor ends the exercise
+        status, _doc = serving.http_json(
+            '127.0.0.1', serve_base + survivor, '/drain', {})
+        assert status == 200, status
+        results = {}
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 60.0 and len(results) < 2:
+            for r in (1, 2):
+                if r in results:
+                    continue
+                p = os.path.join(workdir, f'result-rank{r}.json')
+                if os.path.exists(p):
+                    try:
+                        with open(p) as f:
+                            results[r] = json.load(f)
+                    except (OSError, ValueError):
+                        pass
+            _time.sleep(0.05)
+        if len(results) < 2:
+            _fail("serving drill: replicas never wrote results")
+        for r in (1, 2):
+            assert results[r]['ledger_final'] == \
+                results[r]['ledger_after_warmup'], \
+                f"rank {r} recompiled post-warmup: {results[r]}"
+        assert results[survivor]['reloaded_step'] == 7, results[survivor]
+        for r, p in procs.items():
+            try:
+                rc = p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                _fail(f"serving drill: rank {r} never exited")
+            assert rc == 0, f"rank {r} exited {rc}"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+        ms.stop()
+    served = {r: results[r]['stats'] for r in results}
+    return {
+        'ok': True,
+        'requests': requests,
+        'failed': 0,
+        'failovers': router.failovers,
+        'mttr_seconds': round(mttr, 4),
+        'warmup': {r: ready[r]['warmup'] for r in ready},
+        'stats': served,
+        'reloaded_step': results[survivor]['reloaded_step'],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--worker', action='store_true')
     ap.add_argument('--fleet', action='store_true')
+    ap.add_argument('--serve', action='store_true')
+    ap.add_argument('--rank', type=int, default=1)
+    ap.add_argument('--serve-base', type=int, default=0)
+    ap.add_argument('--replica-base', type=int, default=0)
     ap.add_argument('--slow-rank', type=int, default=1)
     ap.add_argument('--slow-ms', type=float, default=0.0)
     ap.add_argument('--reference', action='store_true')
@@ -837,7 +1146,9 @@ def main(argv=None):
     ap.add_argument('--disk-loss', action='store_true')
     ap.add_argument('--ckpt-owner', type=int, default=None)
     args = ap.parse_args(argv)
-    if args.fleet and args.worker is False and args.reference is False:
+    if args.serve:
+        _serving_worker(args)
+    elif args.fleet and args.worker is False and args.reference is False:
         _fleet_worker(args)
     elif args.worker:
         _worker(args)

@@ -1,0 +1,31 @@
+"""Production inference serving (ref: c_predict_api.h — PAPER.md
+layer 8; ROADMAP item 1, the "millions of users" axis).
+
+Four layers, each reusing a subsystem from PRs 8–15:
+
+- ``batcher``  — continuous batching onto a fixed bucket grid of
+  compiled shapes (zero steady-state recompiles);
+- ``warmup``   — AOT pre-compilation of every bucket through the
+  persistent XLA cache, ledgered per bucket;
+- ``server``   — the replica's HTTP front: POST /predict + the PR 12
+  /metrics//healthz, admission control + OOM shedding, hot weight
+  reload, graceful drain;
+- ``fleet``    — membership-discovered replicas behind a round-robin
+  router with ejection/failover, and checkpoint weight-push over the
+  replica transport.
+"""
+from .batcher import (BlockRunner, InferenceEngine, RequestShed,
+                      RequestTooLarge, ServeError, batch_bucket_for,
+                      parse_buckets, seq_bucket_for)
+from .fleet import (NoReplicasError, Router, discover_replicas,
+                    http_json, push_weights)
+from .server import PredictServer, memory_admission, quantize_weights
+from .warmup import warmup
+
+__all__ = [
+    'BlockRunner', 'InferenceEngine', 'RequestShed', 'RequestTooLarge',
+    'ServeError', 'batch_bucket_for', 'parse_buckets', 'seq_bucket_for',
+    'warmup', 'PredictServer', 'memory_admission', 'quantize_weights',
+    'Router', 'NoReplicasError', 'discover_replicas', 'http_json',
+    'push_weights',
+]

@@ -1,0 +1,271 @@
+"""Fleet front: replica discovery, health-steered routing, weight push.
+
+The "millions of users" axis is horizontal: N identical replica
+processes behind a router. This module is the router half:
+
+- **discovery** — replicas are the ranks alive in the PR 8 membership
+  view (each serves on a base port + rank, the same scheme every other
+  side channel here uses), or an explicit endpoint list;
+- **routing** — round-robin with ejection: a replica that fails
+  ``MXTPU_SERVE_EJECT_FAILURES`` consecutive predicts (connect refused,
+  5xx, shed) is ejected for ``MXTPU_SERVE_READMIT_SECONDS`` and then
+  probed back in via ``/healthz`` — the same health document the PR 12
+  FleetMonitor builds, so a rank the monitor calls a straggler degrades
+  its own /healthz and the router backs off without new machinery;
+  a failed predict FAILS OVER to the next live replica inside one
+  ``predict()`` call, so a draining replica costs a retry, never an
+  error;
+- **weight push** — a new checkpoint reaches replicas over the PR 9
+  replica transport (``dist.file_put`` + ``replica_commit`` into each
+  replica's hosted store, hash-verified and atomically published),
+  then ``POST /reload`` swaps it in with zero recompiles.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time as _time
+
+from ..base import MXNetError, telem_flags as _telem
+from ..telemetry import flight as _flight
+
+__all__ = ['Router', 'discover_replicas', 'http_json', 'push_weights',
+           'NoReplicasError']
+
+
+class NoReplicasError(MXNetError):
+    """Every replica is ejected/unreachable — the fleet is down."""
+
+
+def http_json(host, port, path, doc=None, timeout=10.0):
+    """One JSON round trip: GET when ``doc`` is None, else POST.
+    Returns (status_code, parsed_body_or_None)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        if doc is None:
+            conn.request('GET', path)
+        else:
+            body = json.dumps(doc).encode()
+            conn.request('POST', path, body=body,
+                         headers={'Content-Type': 'application/json',
+                                  'Content-Length': str(len(body))})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            parsed = json.loads(raw.decode('utf-8')) if raw else None
+        except ValueError:
+            parsed = None
+        return resp.status, parsed
+    finally:
+        conn.close()
+
+
+def discover_replicas(membership, serve_port_base, host='127.0.0.1'):
+    """Alive ranks -> [(rank, host, port)] on base + rank, excluding the
+    membership's OWN rank (a router that joined the view as an observer
+    rank never routes to itself). The drill's replicas all live on one
+    host; a real fleet swaps in per-rank hosts from its scheduler here."""
+    view = membership.view() if membership is not None else None
+    if not view:
+        return []
+    self_rank = getattr(membership, 'rank', None)
+    return [(r, host, int(serve_port_base) + r) for r in view['alive']
+            if r != self_rank]
+
+
+class _Replica:
+    __slots__ = ('rank', 'host', 'port', 'fails', 'ejected_until')
+
+    def __init__(self, rank, host, port):
+        self.rank = rank
+        self.host = host
+        self.port = port
+        self.fails = 0
+        self.ejected_until = 0.0
+
+
+class Router:
+    """Round-robin with ejection over a replica set. Thread-safe; one
+    router instance fronts any number of client threads."""
+
+    def __init__(self, endpoints=None, membership=None,
+                 serve_port_base=None, eject_failures=None,
+                 readmit_seconds=None, timeout=10.0):
+        from .. import config as _config
+        self.membership = membership
+        self.serve_port_base = serve_port_base
+        self.timeout = float(timeout)
+        self.eject_failures = int(
+            _config.get('MXTPU_SERVE_EJECT_FAILURES')
+            if eject_failures is None else eject_failures)
+        self.readmit_seconds = float(
+            _config.get('MXTPU_SERVE_READMIT_SECONDS')
+            if readmit_seconds is None else readmit_seconds)
+        self._lock = threading.Lock()
+        self._replicas = {}
+        self._rr = 0
+        self.requests = 0
+        self.failovers = 0
+        if endpoints:
+            for i, (host, port) in enumerate(endpoints):
+                self._replicas[i] = _Replica(i, host, int(port))
+        self.refresh()
+
+    # -- membership --------------------------------------------------------
+
+    def refresh(self):
+        """Re-derive the replica set from the membership view: joined
+        ranks appear, departed/lost ranks drop (a drained replica left
+        the membership — the router stops routing to it without waiting
+        for its ejection threshold)."""
+        if self.membership is None or self.serve_port_base is None:
+            return
+        found = discover_replicas(self.membership, self.serve_port_base)
+        with self._lock:
+            alive = set()
+            for rank, host, port in found:
+                alive.add(rank)
+                if rank not in self._replicas:
+                    self._replicas[rank] = _Replica(rank, host, port)
+            for rank in list(self._replicas):
+                if rank not in alive:
+                    del self._replicas[rank]
+
+    # -- routing -----------------------------------------------------------
+
+    def _candidates(self):
+        """Live-first candidate order starting at the round-robin
+        cursor; ejected replicas past their readmit time re-enter at
+        the back (the next predict is their probe)."""
+        now = _time.monotonic()
+        with self._lock:
+            reps = list(self._replicas.values())
+            self._rr += 1
+            start = self._rr
+        if not reps:
+            return []
+        reps = reps[start % len(reps):] + reps[:start % len(reps)]
+        live = [r for r in reps if r.ejected_until <= now]
+        stale = [r for r in reps if r.ejected_until > now
+                 and now + self.readmit_seconds >= r.ejected_until]
+        return live + stale
+
+    def _mark(self, rep, ok, reason=''):
+        with self._lock:
+            if ok:
+                rep.fails = 0
+                rep.ejected_until = 0.0
+                return
+            rep.fails += 1
+            if rep.fails < self.eject_failures:
+                return
+            rep.ejected_until = _time.monotonic() + self.readmit_seconds
+        _flight.note('serving.eject', rank=rep.rank, port=rep.port,
+                     reason=reason)
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.counter('mxnet_tpu_serving_ejections_total').inc(
+                1, rank=rep.rank)
+
+    def eject(self, rank, reason='external'):
+        """Explicit ejection (a FleetMonitor detector naming a rank,
+        an operator pulling a replica)."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            if rep is None:
+                return
+            rep.fails = self.eject_failures
+            rep.ejected_until = _time.monotonic() + self.readmit_seconds
+        _flight.note('serving.eject', rank=rank, reason=reason)
+
+    def ejected(self):
+        now = _time.monotonic()
+        with self._lock:
+            return sorted(r.rank for r in self._replicas.values()
+                          if r.ejected_until > now)
+
+    def predict(self, inputs, timeout=None):
+        """Route one predict, failing over across replicas: a shed
+        (503), connect failure or 5xx tries the next candidate; only a
+        definitive client error (4xx) or total exhaustion surfaces."""
+        self.refresh()
+        timeout = self.timeout if timeout is None else timeout
+        errors = []
+        for rep in self._candidates():
+            try:
+                status, doc = http_json(rep.host, rep.port, '/predict',
+                                        {'inputs': inputs},
+                                        timeout=timeout)
+            except OSError as e:
+                self._mark(rep, False, f'connect: {e!r}')
+                errors.append(f'rank{rep.rank}: {e!r}')
+                self.failovers += 1
+                continue
+            if status == 200:
+                self._mark(rep, True)
+                self.requests += 1
+                return doc['outputs']
+            if 400 <= status < 500:
+                # our fault, not the replica's — no ejection credit
+                raise MXNetError(
+                    f"predict rejected ({status}): {doc}")
+            self._mark(rep, False, f'status {status}')
+            errors.append(f'rank{rep.rank}: status {status} {doc}')
+            self.failovers += 1
+        raise NoReplicasError(
+            "no replica could serve the request: " + '; '.join(errors)
+            if errors else "no replicas registered")
+
+
+def push_weights(block, step, replicas, ns='serving', timeout=10.0):
+    """Ship a new checkpoint to every replica and hot-swap it in.
+
+    ``replicas``: [{'host', 'replica_port', 'serve_port'}]. The payload
+    travels the PR 9 replica transport — staged ``file_put`` (hash
+    verified on receipt), manifest-validated ``replica_commit`` (atomic
+    publish) — and then ``POST /reload`` points the replica's engine at
+    the committed step. Returns per-replica results."""
+    import os
+    import tempfile
+
+    from ..checkpoint import manifest as mf
+    from ..parallel import dist as _dist
+    fd, tmp = tempfile.mkstemp(suffix='.params')
+    os.close(fd)
+    try:
+        # re-open by path: save_parameters publishes via atomic replace,
+        # so a pre-opened fd would keep reading the original empty inode
+        block.save_parameters(tmp)
+        with open(tmp, 'rb') as f:
+            data = f.read()
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    manifest = json.dumps({
+        'format_version': mf.FORMAT_VERSION, 'step': int(step),
+        'blobs': [{'name': 'weights', 'file': 'weights.params',
+                   'bytes': len(data),
+                   'sha256': mf.sha256_bytes(data)}],
+    }).encode()
+    results = {}
+    for rep in replicas:
+        host = rep.get('host', '127.0.0.1')
+        try:
+            _dist.file_put(host, rep['replica_port'], ns, step,
+                           'weights.params', data, timeout=timeout)
+            _dist.file_put(host, rep['replica_port'], ns, step,
+                           mf.MANIFEST_NAME, manifest, timeout=timeout)
+            _dist.replica_commit(host, rep['replica_port'], ns, step,
+                                 timeout=timeout)
+            status, doc = http_json(host, rep['serve_port'], '/reload',
+                                    {'ns': ns, 'step': int(step)},
+                                    timeout=timeout)
+            results[rep['serve_port']] = {'status': status, 'doc': doc}
+        except Exception as e:                        # noqa: BLE001
+            results[rep['serve_port']] = {'error': repr(e)}
+    _flight.note('serving.weight_push', step=int(step),
+                 replicas=len(replicas))
+    return results

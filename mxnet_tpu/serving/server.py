@@ -1,0 +1,239 @@
+"""Replica predict server: ``POST /predict`` on the PR 12 endpoint.
+
+One serving replica = one ``InferenceEngine`` fronted by the same
+bounded stdlib HTTP server the telemetry endpoint uses — ``/metrics``,
+``/healthz`` and ``/flight`` keep working unchanged (a router ejects on
+the SAME /healthz document a fleet operator reads), and three POST
+routes are added:
+
+- ``POST /predict``  {"inputs": [...]} — one sequence or a list of
+  sequences; every sequence rides the continuous batcher. Admission
+  control sheds with 503 **before** touching the device: replica
+  draining, engine queue full, or live device memory above
+  ``MXTPU_SERVE_MEMORY_LIMIT_MB`` (read from the PR 14 memory
+  observability, the same numbers /healthz reports). An OOM inside the
+  dispatch sheds that batch with 503 too — the replica never dies of a
+  burst.
+- ``POST /reload``   {"ns": ..., "step": ...} (or {"path": ...}) —
+  swap in new weights: the fleet front stages a checkpoint over the
+  replica transport (``dist.file_put`` + ``replica_commit`` into this
+  replica's store), then points this route at it. Shapes are
+  unchanged, so the swap needs NO recompile — the compiled programs
+  read parameters per call.
+- ``POST /drain``    — graceful exit: stop admitting, flush in-flight
+  requests, leave the membership (peers see a departure, not a
+  failure), then close the listener. SIGTERM does the same via
+  ``install_sigterm``.
+
+Weight quantization for the predict path rides the PR 11 codecs:
+``quantize_weights(block, 'bf16')`` casts parameters (true 2x
+residency); ``'int8'`` snaps each float parameter to the codec's
+block-scaled int8 value grid in place (the values an int8-weights
+deployment would serve, stored in float for this backend — honest
+about residency, exact about accuracy effects).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+
+import numpy as onp
+
+from ..base import MXNetError, telem_flags as _telem
+from ..telemetry import flight as _flight, memory as _memory, \
+    trace as _trace
+from ..telemetry.server import TelemetryServer
+from .batcher import RequestShed, RequestTooLarge, ServeError
+
+__all__ = ['PredictServer', 'quantize_weights', 'memory_admission']
+
+
+def quantize_weights(block, mode):
+    """Quantize a block's weights for serving. Returns the block."""
+    if not mode or mode == 'none':
+        return block
+    if mode in ('bf16', 'bfloat16'):
+        block.cast('bfloat16')
+        return block
+    if mode == 'int8':
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+        from ..parallel import compression as _compression
+        for p in block.collect_params().values():
+            d = p.data()._data
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                q = _compression.encode_decode(d, 'int8')
+                p.set_data(NDArray(q))
+        return block
+    raise MXNetError(
+        f"unknown MXTPU_SERVE_QUANTIZE mode {mode!r} "
+        f"(use '', 'bf16' or 'int8')")
+
+
+def memory_admission(limit_mb=None):
+    """Admission predicate over the PR 14 memory observability: returns
+    a shed reason when live device bytes exceed the limit, else None.
+    ``limit_mb=None`` reads ``MXTPU_SERVE_MEMORY_LIMIT_MB``; 0 = off."""
+    from .. import config as _config
+    if limit_mb is None:
+        limit_mb = float(_config.get('MXTPU_SERVE_MEMORY_LIMIT_MB'))
+    if not limit_mb or limit_mb <= 0:
+        return None
+
+    def _admit():
+        try:
+            live = _memory.health_fields().get('live_bytes') or 0
+        except Exception:
+            return None
+        if live > limit_mb * (1 << 20):
+            return f'memory_pressure ({live >> 20}MiB > {limit_mb:g}MiB)'
+        return None
+    return _admit
+
+
+class PredictServer(TelemetryServer):
+    """One replica's front door. ``engine`` is an ``InferenceEngine``;
+    ``block`` (optional) enables /reload; ``replica_root`` (optional)
+    is this replica's ``ReplicaServer`` store so /reload can resolve a
+    transport-pushed checkpoint by (ns, step)."""
+
+    max_body_bytes = 4 << 20
+
+    def __init__(self, engine, port=0, bind=None, membership=None,
+                 block=None, replica_root=None, max_handlers=8,
+                 start=True):
+        self.engine = engine
+        self.block = block
+        self.replica_root = replica_root
+        self.draining = threading.Event()
+        self.reloaded_step = None
+        super().__init__(port=port, bind=bind, membership=membership,
+                         max_handlers=max_handlers, start=start)
+
+    # -- routes ------------------------------------------------------------
+
+    def _route(self, path, method='GET', body=b''):
+        if method == 'POST':
+            if body is None:
+                return ('413 Payload Too Large', 'application/json',
+                        b'{"error": "body too large"}')
+            if path == '/predict':
+                return self._predict(body)
+            if path == '/reload':
+                return self._reload(body)
+            if path == '/drain':
+                return self._drain_async()
+            return ('404 Not Found', 'text/plain',
+                    b'POST endpoints: /predict /reload /drain\n')
+        return super()._route(path, method, body)
+
+    @staticmethod
+    def _json(status, doc):
+        return (status, 'application/json',
+                json.dumps(doc, default=str).encode())
+
+    def _predict(self, body):
+        t0 = _time.monotonic()
+        if self.draining.is_set():
+            return self._json('503 Service Unavailable',
+                              {'error': 'draining'})
+        try:
+            doc = json.loads(body.decode('utf-8'))
+            inputs = doc['inputs']
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            return self._json('400 Bad Request',
+                              {'error': f'bad request body: {e!r}'})
+        single = bool(inputs) and not isinstance(inputs[0], (list, tuple))
+        seqs = [inputs] if single else inputs
+        try:
+            with _trace.span('serving.predict', n=len(seqs)):
+                handles = [self.engine.submit_async(s) for s in seqs]
+                outs = [self.engine.result(h) for h in handles]
+        except ServeError as e:
+            status = {503: '503 Service Unavailable',
+                      400: '400 Bad Request'}.get(e.status,
+                                                  '500 Internal Server Error')
+            return self._json(status, {'error': str(e)})
+        except Exception as e:                        # noqa: BLE001
+            return self._json('500 Internal Server Error',
+                              {'error': repr(e)})
+        payload = [onp.asarray(o, onp.float64).tolist() for o in outs]
+        return self._json('200 OK', {
+            'outputs': payload[0] if single else payload,
+            'latency_ms': round((_time.monotonic() - t0) * 1e3, 3)})
+
+    def _reload(self, body):
+        if self.block is None:
+            return self._json('400 Bad Request',
+                              {'error': 'no block attached'})
+        try:
+            doc = json.loads(body.decode('utf-8')) if body else {}
+        except ValueError as e:
+            return self._json('400 Bad Request', {'error': repr(e)})
+        path = doc.get('path')
+        step = doc.get('step')
+        if path is None:
+            if self.replica_root is None or step is None:
+                return self._json('400 Bad Request', {
+                    'error': "need 'path' or ('ns' + 'step' with a "
+                             "replica_root)"})
+            from ..checkpoint import manifest as mf
+            d = os.path.join(self.replica_root,
+                             str(doc.get('ns', 'serving')),
+                             mf.step_dir_name(int(step)))
+            try:
+                mf.validate_step_dir(d)
+            except Exception as e:
+                return self._json('409 Conflict',
+                                  {'error': f'checkpoint invalid: {e}'})
+            path = os.path.join(d, 'weights.params')
+        try:
+            # per-call parameter reads mean the swap needs no recompile:
+            # same shapes, new values, next batch serves the new weights
+            self.block.load_parameters(path)
+        except Exception as e:                        # noqa: BLE001
+            return self._json('500 Internal Server Error',
+                              {'error': repr(e)})
+        self.reloaded_step = step
+        _flight.note('serving.reload', step=step, path=path)
+        return self._json('200 OK', {'reloaded': True, 'step': step})
+
+    # -- drain -------------------------------------------------------------
+
+    def _drain_async(self):
+        threading.Thread(target=self.drain, daemon=True,
+                         name='mxtpu-serve-drain').start()
+        return self._json('200 OK', {'draining': True})
+
+    def drain(self):
+        """Graceful exit: finish in-flight work, leave the membership,
+        close the listener. Idempotent."""
+        if self.draining.is_set():
+            return
+        self.draining.set()
+        flushed = self.engine.drain()
+        _flight.note('serving.drain', flushed=flushed,
+                     rank=getattr(self.membership, 'rank', None))
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.counter(
+                'mxnet_tpu_serving_drained_replicas_total').inc(1)
+        ms = self.membership
+        if ms is not None:
+            try:
+                ms.leave()
+            except Exception:
+                pass
+        self.stop()
+
+    def install_sigterm(self):
+        """SIGTERM -> graceful drain (the preemption path). Main thread
+        only (signal module restriction)."""
+        import signal as _signal
+
+        def _term(_sig, _frm):
+            threading.Thread(target=self.drain, daemon=True,
+                             name='mxtpu-serve-drain').start()
+        _signal.signal(_signal.SIGTERM, _term)

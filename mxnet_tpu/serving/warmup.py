@@ -1,0 +1,74 @@
+"""AOT warmup: pre-compile every serving bucket before the first
+request arrives.
+
+A replica that compiles lazily pays each bucket's XLA compile on the
+first unlucky request — seconds of p99 at the worst possible time.
+The warmup pass walks the engine's full ``(batch, seq)`` bucket grid
+at startup and dispatches one dummy batch per shape:
+
+- with ``MXTPU_COMPILE_CACHE_DIR`` set, the compiles go through the
+  persistent XLA cache — the FIRST replica on a machine pays the cold
+  compile, every later one (and every restart) replays it in
+  milliseconds;
+- each bucket's cold-start seconds are ledgered through the PR 15
+  compile ledger (``serving:warmup_b{B}_s{S}`` sites) via
+  ``compile.watching`` — a bucket served from cache records nothing,
+  so the ledger is exactly the list of compiles this process paid for;
+- after warmup the steady state replays compiled programs only: the
+  recompile detector staying silent is asserted by
+  ``tests/test_serving.py`` and the dryrun serving stage.
+"""
+from __future__ import annotations
+
+import time as _time
+
+from ..base import telem_flags as _telem
+from ..telemetry import compile as _compile
+
+__all__ = ['warmup']
+
+
+def warmup(engine):
+    """Pre-build every bucket shape; returns the per-bucket report::
+
+        {'buckets': {'b4_s64': seconds, ...},
+         'total_seconds': ..., 'compiles': <ledger entries written>,
+         'cache': <persistent_cache_stats() delta-free snapshot>}
+    """
+    from ..telemetry import metrics as _metrics
+    t0 = _time.perf_counter()
+    before = len(_compile.ledger()) if _compile.enabled() else 0
+    report = {}
+    # the recompile detector counts per-site compiles — warmup compiles
+    # the whole bucket grid at each site ON PURPOSE, so mute the
+    # threshold for the pass. It restores right after: the very next
+    # steady-state compile (a bucketing bug) warns immediately, because
+    # the episode counter already sits above the threshold.
+    prev = _metrics._recompile_threshold
+    _metrics.set_recompile_threshold(1 << 30)
+    try:
+        for b, s in engine.bucket_grid():
+            site = f'serving:warmup_b{b}_s{s}'
+            tb = _time.perf_counter()
+            with _compile.watching(site, sig_fn=lambda b=b, s=s:
+                                   _compile.signature(args=[
+                                       _compile.arg_sig('batch', (b, s),
+                                                        str(engine.dtype))],
+                                       flags={'engine': engine.name})):
+                engine.run_bucket(b, s)
+            report[f'b{b}_s{s}'] = round(_time.perf_counter() - tb, 4)
+    finally:
+        _metrics.set_recompile_threshold(prev)
+    total = _time.perf_counter() - t0
+    compiles = (len(_compile.ledger()) - before) if _compile.enabled() \
+        else None
+    out = {'buckets': report, 'total_seconds': round(total, 4),
+           'compiles': compiles,
+           'cache': _compile.persistent_cache_stats()}
+    if _telem['on']:
+        from .. import telemetry as _telemetry
+        _telemetry.set_gauge('mxnet_tpu_serving_warmup_buckets',
+                             len(report), engine=engine.name)
+        _telemetry.set_gauge('mxnet_tpu_serving_warmup_seconds',
+                             round(total, 4), engine=engine.name)
+    return out

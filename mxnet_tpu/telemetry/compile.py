@@ -132,7 +132,11 @@ def clear(ring=None, ledger=_UNSET, cache_dir=_UNSET):
             _cfg['ledger'] = ledger
         if cache_dir is not _UNSET:
             _cfg['cache_dir'] = cache_dir
-            _cache_state['applied'] = None
+            # keep _cache_state['applied'] — _ensure_persistent_cache
+            # compares it against the new dir to re-point (or, for '',
+            # UN-point) jax's cache config
+    if cache_dir is not _UNSET:
+        _ensure_persistent_cache()
 
 
 def _ring_cap() -> int:
@@ -184,10 +188,23 @@ def enable_persistent_cache(path):
 
 def _ensure_persistent_cache():
     d = _cache_dir()
-    if not d:
-        return ''
     if _cache_state['applied'] == d:
         return d
+    if not d:
+        # a dir WAS applied and is now unset (often a TemporaryDirectory
+        # that no longer exists): un-point jax or every later compile in
+        # the process warns trying to write cache entries into the grave
+        if _cache_state['applied']:
+            try:
+                import jax
+                jax.config.update('jax_compilation_cache_dir', None)
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc)
+                _cc.reset_cache()
+            except Exception:
+                pass
+            _cache_state['applied'] = None
+        return ''
     try:
         import jax
         os.makedirs(d, exist_ok=True)

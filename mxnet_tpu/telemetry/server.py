@@ -146,51 +146,82 @@ class TelemetryServer:
                 except OSError:
                     pass
 
+    # bodies a subclass accepts on POST (0 = GET-only, the telemetry
+    # default: a scraper has no business sending us bytes)
+    max_body_bytes = 0
+
     def _handle_conn(self, conn):
         try:
             conn.settimeout(5.0)
             with conn:
-                path = self._read_request(conn)
-                if path is None:
+                req = self._read_request(conn)
+                if req is None:
                     return
+                method, path, body = req
                 with self._lock:
                     self.requests += 1
-                status, ctype, body = self._route(path)
+                status, ctype, resp = self._route(path, method, body)
                 head = (f'HTTP/1.0 {status}\r\n'
                         f'Content-Type: {ctype}\r\n'
-                        f'Content-Length: {len(body)}\r\n'
+                        f'Content-Length: {len(resp)}\r\n'
                         f'Connection: close\r\n\r\n')
-                conn.sendall(head.encode() + body)
+                conn.sendall(head.encode() + resp)
         except (OSError, ValueError):
             pass
         finally:
             self._slots.release()
 
-    @staticmethod
-    def _read_request(conn, deadline_seconds=5.0):
-        """Path of a GET request, or None for anything malformed. Reads
-        at most _MAX_REQUEST_BYTES within ONE overall wall deadline —
-        headers are ignored, bodies rejected by the byte bound, and a
-        trickling client (one byte per recv, each resetting the socket
-        timeout) cannot hold a handler slot past the deadline."""
+    def _read_request(self, conn, deadline_seconds=5.0):
+        """(method, path, body) of a GET/POST request, or None for
+        anything malformed. Reads at most _MAX_REQUEST_BYTES of header
+        plus ``max_body_bytes`` of declared body within ONE overall
+        wall deadline — a trickling client (one byte per recv, each
+        resetting the socket timeout) cannot hold a handler slot past
+        the deadline. A body larger than the bound returns body=None
+        (413 upstream) instead of buffering unboundedly."""
         deadline = _time.monotonic() + deadline_seconds
         data = b''
-        while b'\r\n' not in data and len(data) < _MAX_REQUEST_BYTES:
+        while b'\r\n\r\n' not in data and len(data) < _MAX_REQUEST_BYTES:
             if _time.monotonic() > deadline:
                 return None
-            b = conn.recv(1024)
+            b = conn.recv(4096)
             if not b:
                 break
             data += b
-        line = data.split(b'\r\n', 1)[0].decode('latin-1', 'replace')
-        parts = line.split()
-        if len(parts) < 2 or parts[0] != 'GET':
+        head, _, rest = data.partition(b'\r\n\r\n')
+        lines = head.split(b'\r\n')
+        parts = lines[0].decode('latin-1', 'replace').split()
+        if len(parts) < 2 or parts[0] not in ('GET', 'POST'):
             return None
-        return parts[1].split('?', 1)[0]
+        method, path = parts[0], parts[1].split('?', 1)[0]
+        if method == 'GET':
+            return method, path, b''
+        length = 0
+        for ln in lines[1:]:
+            k, _, v = ln.decode('latin-1', 'replace').partition(':')
+            if k.strip().lower() == 'content-length':
+                try:
+                    length = int(v.strip())
+                except ValueError:
+                    return None
+        if length > self.max_body_bytes:
+            return method, path, None
+        body = rest[:length]
+        while len(body) < length:
+            if _time.monotonic() > deadline:
+                return None
+            b = conn.recv(min(65536, length - len(body)))
+            if not b:
+                break
+            body += b
+        return method, path, body
 
     # -- routing -----------------------------------------------------------
 
-    def _route(self, path):
+    def _route(self, path, method='GET', body=b''):
+        if method != 'GET':
+            return ('405 Method Not Allowed', 'text/plain',
+                    b'GET only\n')
         try:
             if path == '/metrics':
                 from . import fleet as _fleet

@@ -20,6 +20,16 @@ import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        'slow: long e2e drills and model sweeps excluded from the tier-1 '
+        "budget (`-m 'not slow'`). Everything marked slow is either "
+        'duplicated by a dryrun_multichip stage that runs in every '
+        'MULTICHIP round, or a multi-minute model-zoo one-off; run them '
+        'with `pytest -m slow`.')
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import mxnet_tpu as mx

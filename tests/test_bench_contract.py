@@ -127,6 +127,54 @@ def test_compile_report_respects_child_deadline(bench, monkeypatch):
     assert rep['cache_ab'] == {'skipped': 'child deadline too close'}
 
 
+def test_serving_report_contract(bench, monkeypatch):
+    """The "serving" field (ISSUE 17): a measured deadline sweep with
+    QPS + p50/p99 per point, an int8 A/B with bounded output drift, and
+    the fleet numbers from the (stubbed) two-replica drill — the
+    in-process half runs for real on the tiny model, the subprocess
+    drill is pinned."""
+    import mxnet_tpu.resilience.drill as drill
+    fake = {
+        'ok': True, 'requests': 90, 'failed': 0, 'failovers': 2,
+        'mttr_seconds': 0.21, 'reloaded_step': 7,
+        'warmup': {1: {'total_seconds': 0.9, 'compiles': 19,
+                       'cache': {'hits': 0, 'misses': 15}},
+                   2: {'total_seconds': 0.5, 'compiles': 19,
+                       'cache': {'hits': 15, 'misses': 0}}},
+        'stats': {1: {'p50_ms': 5.1}, 2: {'p50_ms': 4.9}},
+    }
+    monkeypatch.setattr(drill, 'run_serving_drill',
+                        lambda td, timeout=180.0: fake)
+    monkeypatch.delenv('BENCH_CHILD_DEADLINE', raising=False)
+    rep = bench._serving_report(requests=12, deadlines=(0.0, 2.0))
+    assert rep['warmup']['compiles'] > 0
+    sweep = rep['deadline_sweep']
+    assert set(sweep) == {'0ms', '2ms'}
+    for point in sweep.values():
+        assert point['qps'] > 0 and not point['errors']
+        assert point['p99_ms'] >= point['p50_ms']
+    assert rep['int8_ab']['max_output_drift'] < 0.1
+    fleet = rep['fleet']
+    assert fleet['failed'] == 0 and fleet['mttr_seconds'] == 0.21
+    assert fleet['warm_cache_hits'] == 15
+    assert fleet['warmup_warm_seconds'] < fleet['warmup_cold_seconds']
+
+
+def test_serving_report_fleet_respects_child_deadline(bench, monkeypatch):
+    """Too little left on the child budget: the fleet drill is skipped,
+    never spawned — the flagship metric's deadline wins (the same
+    contract as the compile A/B)."""
+    import mxnet_tpu.resilience.drill as drill
+
+    def boom(*_a, **_k):
+        raise AssertionError("drill must not spawn under a tight deadline")
+    monkeypatch.setattr(drill, 'run_serving_drill', boom)
+    monkeypatch.setenv('BENCH_CHILD_DEADLINE',
+                       str(bench.time.time() + 60))
+    rep = bench._serving_report(requests=8, deadlines=(2.0,))
+    assert rep['fleet'] == {'skipped': 'child deadline too close'}
+
+
 def test_total_failure_fallback_carries_error(bench, capsys, monkeypatch):
     """Only when NO metric line could be produced does top-level
     "error" appear — and it names the measurement failures, with probe

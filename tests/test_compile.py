@@ -275,6 +275,66 @@ def test_persistent_cache_hits_and_saved_estimate(tmp_path):
         cc.reset_cache()
 
 
+def test_clear_cache_dir_unpoints_jax(tmp_path):
+    """clear(cache_dir='') must UN-point jax's persistent cache, not
+    just forget the config — the dir is usually a TemporaryDirectory,
+    and a stale jax_compilation_cache_dir makes every later compile in
+    the process warn trying to write entries into the grave (seen as
+    UserWarning spam between dryrun stages)."""
+    import shutil
+    import jax
+    import jax.numpy as jnp
+    comp.enable()
+    d = tmp_path / 'xla_cache'
+    comp.clear(cache_dir=str(d))
+    try:
+        ctx = comp.begin('t:unpoint', _span=False)
+        try:
+            jax.jit(lambda x: x + 1)(jnp.ones(3)).block_until_ready()
+        finally:
+            comp.end(ctx)
+        assert d.is_dir()
+        shutil.rmtree(d)
+        comp.clear(cache_dir='')
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter('always')
+            jax.jit(lambda x: x * 3 + 2)(jnp.ones(7)).block_until_ready()
+        stale = [w for w in caught
+                 if 'compilation cache' in str(w.message)]
+        assert not stale, [str(w.message) for w in stale]
+    finally:
+        comp.clear(cache_dir='')
+
+
+def test_compile_window_nests_inside_step_dispatch_span():
+    """Armed trace + armed compile plane over a first step dispatch:
+    the compile.build window must open INSIDE the step.dispatch span —
+    a window straddling the span boundary (begin before the span, end
+    within it) interleaves the chrome B/E stream, and check_trace
+    flags the whole trace as corrupt."""
+    import jax
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, ShardedTrainStep
+    from tools import check_trace
+    comp.enable()
+    trace.enable()
+    mesh = make_mesh((1,), ('dp',), devices=jax.devices()[:1])
+    net = nn.Dense(1, in_units=6)
+    net.initialize()
+    step = ShardedTrainStep(net, gluon.loss.L2Loss(), 'adam',
+                            {'learning_rate': 0.01}, mesh=mesh)
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.rand(8, 6).astype(onp.float32))
+    y = mx.nd.array(rng.rand(8, 1).astype(onp.float32))
+    step(x, y)   # first dispatch: the compile window is open in here
+    step(x, y)   # steady state
+    evs = trace.chrome_events(metadata=True)
+    assert check_trace.check_events(evs) == []
+    names = [e['name'] for e in evs if e.get('ph') == 'B']
+    assert 'compile.build' in names and 'step.dispatch' in names
+
+
 # ---------------------------------------------------------------------------
 # COMPILING stall verdict
 # ---------------------------------------------------------------------------

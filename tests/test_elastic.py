@@ -546,6 +546,7 @@ def test_trainer_attach_elastic_preemption(tmp_path):
 # the e2e drill (satellite: multi-process elastic drill in CI)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # duplicated by the dryrun_multichip elastic stage
 def test_elastic_drill_kill_one_of_two_workers(tmp_path):
     """Spawn 2 subprocess workers, SIGKILL one mid-step: the survivor
     must detect within the peer deadline, commit, re-form at world

@@ -903,6 +903,7 @@ def test_dump_rank_trace_embeds_rank_and_offset(tmp_path):
 # stitched trace clean
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # duplicated by the dryrun_multichip fleet stage
 def test_fleet_drill_end_to_end(tmp_path):
     from mxnet_tpu.resilience.drill import run_fleet_drill
     result = run_fleet_drill(str(tmp_path))

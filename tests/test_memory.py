@@ -267,6 +267,7 @@ def test_memory_analysis_bucket_sum_reconstructs_peak(zero):
         assert rep['gather_bytes_per_layer']
 
 
+@pytest.mark.slow  # duplicated by the dryrun_multichip memory stage
 def test_memory_analysis_zero_shrink_is_measured():
     """The ZeRO state/param shrink read straight off the MEASURED
     buckets (not the analytic byte-counting): zero1 shrinks

@@ -749,6 +749,7 @@ def test_manifest_cli_scrub_exit_codes(tmp_path):
 # the e2e disk-loss drill
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # duplicated by the dryrun_multichip disk-loss stage
 def test_disk_loss_drill_survivor_restores_from_replica(tmp_path):
     """Acceptance: two-worker drill with the checkpoint OWNER's
     directory wiped before its SIGKILL — the survivor restores from the

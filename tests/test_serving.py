@@ -1,0 +1,465 @@
+"""Production inference serving (ISSUE 17): continuous batching onto a
+fixed bucket grid, AOT warmup through the persistent compile cache,
+replica server + fleet router, and the zero-recompile steady state.
+
+The drill test at the bottom is the two-process acceptance path:
+SIGTERM one replica mid-storm -> zero failed requests, router ejects
+via the membership departure, weight push lands on the survivor."""
+import glob
+import os
+import threading
+import time
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.serving.batcher import (batch_bucket_for, parse_buckets,
+                                       seq_bucket_for)
+from mxnet_tpu.telemetry import compile as comp, memory, metrics
+
+
+@pytest.fixture(autouse=True)
+def _telem():
+    telemetry.reset()
+    telemetry.enable()
+    comp.enable()
+    yield
+    faults.disarm()
+    metrics.set_recompile_threshold(None)
+    comp.disable()
+    comp.clear(ledger='', cache_dir='')
+    telemetry.reset()
+    telemetry.disable()
+
+
+class TokModel(nn.HybridBlock):
+    def __init__(self, vocab=64, dim=8, classes=4, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab, dim)
+            self.proj = nn.Dense(classes, flatten=False)
+
+    def forward(self, x):
+        return self.proj(self.embed(x))
+
+
+def _engine(**kw):
+    net = TokModel()
+    net.initialize()
+    kw.setdefault('seq_buckets', '8,16')
+    kw.setdefault('batch_buckets', '1,2,4')
+    kw.setdefault('deadline_ms', 2.0)
+    eng = serving.InferenceEngine(serving.BlockRunner(net), **kw)
+    return net, eng
+
+
+# ---------------------------------------------------------------------------
+# bucketing helpers
+# ---------------------------------------------------------------------------
+
+def test_parse_buckets_sorts_and_dedupes():
+    assert parse_buckets('128, 32,64,32') == (32, 64, 128)
+    with pytest.raises(MXNetError):
+        parse_buckets('')
+    with pytest.raises(MXNetError):
+        parse_buckets('0,8')
+
+
+def test_bucket_selection_smallest_fit():
+    assert seq_bucket_for(1, (32, 64)) == 32
+    assert seq_bucket_for(32, (32, 64)) == 32
+    assert seq_bucket_for(33, (32, 64)) == 64
+    assert seq_bucket_for(65, (32, 64)) is None
+    assert batch_bucket_for(3, (1, 2, 4)) == 4
+    assert batch_bucket_for(4, (1, 2, 4)) == 4
+
+
+def test_bucket_grid_is_the_full_universe_largest_first():
+    _net, eng = _engine()
+    grid = eng.bucket_grid()
+    assert len(grid) == 2 * 3
+    assert grid[0] == (4, 16)          # most expensive shape compiles first
+    assert set(grid) == {(b, s) for s in (8, 16) for b in (1, 2, 4)}
+    eng.drain()
+
+
+# ---------------------------------------------------------------------------
+# batch formation: deadline vs fill
+# ---------------------------------------------------------------------------
+
+def test_fill_dispatches_before_deadline():
+    _net, eng = _engine(deadline_ms=2000.0, batch_buckets='1,4')
+    serving.warmup(eng)
+    t0 = time.monotonic()
+    handles = [eng.submit_async([1, 2, 3]) for _ in range(4)]
+    outs = [eng.result(h, timeout=10.0) for h in handles]
+    took = time.monotonic() - t0
+    assert all(o.shape == (3, 4) for o in outs)
+    # a full batch must not wait for the 2-second deadline
+    assert took < 1.0, took
+    eng.drain()
+
+
+def test_deadline_dispatches_a_lone_request():
+    _net, eng = _engine(deadline_ms=300.0, batch_buckets='4')
+    serving.warmup(eng)
+    t0 = time.monotonic()
+    out = eng.submit([1, 2, 3], timeout=10.0)
+    took = time.monotonic() - t0
+    assert out.shape == (3, 4)
+    # a lone request rides the deadline, not the fill
+    assert took >= 0.25, took
+    eng.drain()
+
+
+# ---------------------------------------------------------------------------
+# padding parity + zero-recompile storm
+# ---------------------------------------------------------------------------
+
+def test_padding_parity_bit_identical():
+    net, eng = _engine()
+    serving.warmup(eng)
+    seq = [5, 9, 2, 41, 7]
+    out = eng.submit(seq, timeout=10.0)
+    padded = onp.asarray([seq + [0] * 3], 'int32')
+    solo = onp.asarray(net(nd.array(padded)).asnumpy())[0, :5]
+    assert out.shape == (5, 4)
+    assert onp.array_equal(out, solo), (out, solo)
+    eng.drain()
+
+
+def test_zero_recompiles_after_warmup_randomized_storm():
+    _net, eng = _engine()
+    rep = serving.warmup(eng)
+    assert rep['compiles'] and rep['compiles'] > 0
+    n_led = len(comp.ledger())
+    rng = onp.random.RandomState(3)
+    errs = []
+
+    def client():
+        try:
+            length = int(rng.randint(1, 17))
+            out = eng.submit(list(rng.randint(0, 64, length)),
+                             timeout=30.0)
+            assert out.shape == (length, 4)
+        except Exception as e:                        # noqa: BLE001
+            errs.append(e)
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        threads = [threading.Thread(target=client) for _ in range(40)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errs, errs
+    recompiled = [w for w in caught
+                  if 'Recompile' in type(w.message).__name__]
+    assert not recompiled, [str(w.message) for w in recompiled]
+    assert len(comp.ledger()) == n_led, \
+        f"storm recompiled: {comp.ledger()[n_led:]}"
+    st = eng.stats()
+    assert st['requests'] == 40 and st['shed'] == 0
+    assert st['p50_ms'] is not None and st['p99_ms'] >= st['p50_ms']
+    eng.drain()
+
+
+def test_warmup_report_and_threshold_restore():
+    _net, eng = _engine()
+    metrics.set_recompile_threshold(5)
+    rep = serving.warmup(eng)
+    # the warmup pass mutes the detector, then restores the caller's
+    # threshold — warmup compiling the whole grid is the point
+    assert metrics._recompile_threshold == 5
+    assert set(rep['buckets']) == {f'b{b}_s{s}'
+                                   for b, s in eng.bucket_grid()}
+    assert rep['total_seconds'] > 0
+    assert telemetry.value('mxnet_tpu_serving_warmup_buckets',
+                           engine=eng.name) == 6
+    eng.drain()
+
+
+# ---------------------------------------------------------------------------
+# shedding: OOM guard, admission control, queue limit, oversized
+# ---------------------------------------------------------------------------
+
+def test_oom_sheds_batch_and_replica_survives(tmp_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_FLIGHT_DIR', str(tmp_path))
+    memory.enable()
+    _net, eng = _engine()
+    serving.warmup(eng)
+    faults.arm('alloc.oom', 'raise', window=1)
+    with pytest.raises(serving.RequestShed):
+        eng.submit([1, 2, 3], timeout=10.0)
+    faults.disarm()
+    # the replica survives the burst: the next request serves
+    out = eng.submit([1, 2, 3], timeout=10.0)
+    assert out.shape == (3, 4)
+    assert eng.stats()['shed'] >= 1
+    eng.drain()
+
+
+def test_admission_control_sheds_before_the_device():
+    _net, eng = _engine(admission=lambda: 'memory_pressure')
+    with pytest.raises(serving.RequestShed, match='memory_pressure'):
+        eng.submit([1, 2, 3])
+    assert eng.stats()['shed'] == 1
+    eng.drain()
+
+
+def test_queue_limit_sheds():
+    _net, eng = _engine(queue_limit=1, deadline_ms=5000.0,
+                        batch_buckets='4')
+    eng.submit_async([1, 2, 3])          # parks waiting for fill
+    with pytest.raises(serving.RequestShed, match='queue full'):
+        eng.submit_async([4, 5])
+    eng.drain()
+
+
+def test_too_long_request_is_a_client_error():
+    _net, eng = _engine()
+    with pytest.raises(serving.RequestTooLarge):
+        eng.submit(list(range(17)))
+    eng.drain()
+
+
+def test_memory_admission_predicate(monkeypatch):
+    assert serving.memory_admission(0) is None
+    admit = serving.memory_admission(1.0)    # 1 MiB limit
+    monkeypatch.setattr(memory, 'health_fields',
+                        lambda: {'live_bytes': 8 << 20})
+    assert 'memory_pressure' in admit()
+    monkeypatch.setattr(memory, 'health_fields',
+                        lambda: {'live_bytes': 0})
+    assert admit() is None
+
+
+# ---------------------------------------------------------------------------
+# weight quantization
+# ---------------------------------------------------------------------------
+
+def _tok_model():
+    net = TokModel()
+    net.initialize()
+    net(nd.array(onp.zeros((1, 8), 'int32')))   # materialize deferred params
+    return net
+
+
+def test_quantize_weights_bf16_and_int8():
+    net = _tok_model()
+    serving.quantize_weights(net, 'bf16')
+    assert str(net.proj.weight.data().dtype) == 'bfloat16'
+    net2 = _tok_model()
+    before = onp.asarray(net2.proj.weight.data().asnumpy()).copy()
+    serving.quantize_weights(net2, 'int8')
+    after = onp.asarray(net2.proj.weight.data().asnumpy())
+    assert not onp.array_equal(before, after)       # snapped to the grid
+    assert onp.allclose(before, after, atol=0.1)    # but nearby
+    with pytest.raises(MXNetError):
+        serving.quantize_weights(net2, 'fp4')
+    assert serving.quantize_weights(net2, '') is net2
+
+
+# ---------------------------------------------------------------------------
+# replica server routes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def served():
+    net, eng = _engine()
+    serving.warmup(eng)
+    srv = serving.PredictServer(eng, block=net)
+    yield net, eng, srv
+    srv.stop()
+    eng.drain()
+
+
+def test_predict_single_and_list(served):
+    _net, _eng, srv = served
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                {'inputs': [1, 2, 3]})
+    assert st == 200 and len(doc['outputs']) == 3
+    assert doc['latency_ms'] > 0
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                {'inputs': [[1, 2, 3], [4, 5]]})
+    assert st == 200
+    assert len(doc['outputs']) == 2 and len(doc['outputs'][1]) == 2
+
+
+def test_predict_client_errors(served):
+    _net, _eng, srv = served
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                {'wrong_key': 1})
+    assert st == 400, doc
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                {'inputs': list(range(99))})
+    assert st == 400, doc
+    st, _doc = serving.http_json('127.0.0.1', srv.port, '/nope', {})
+    assert st == 404
+    # the inherited GET routes still answer
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/healthz')
+    assert st in (200, 503) and isinstance(doc, dict)
+
+
+def test_reload_by_path_swaps_weights(served, tmp_path):
+    net, _eng, srv = served
+    donor = _tok_model()
+    path = str(tmp_path / 'weights.params')
+    donor.save_parameters(path)
+    st, before = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                   {'inputs': [1, 2, 3]})
+    assert st == 200
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/reload',
+                                {'path': path})
+    assert st == 200 and doc['reloaded'], doc
+    st, after = serving.http_json('127.0.0.1', srv.port, '/predict',
+                                  {'inputs': [1, 2, 3]})
+    assert st == 200
+    # the donor's weights differ, so the outputs must flip...
+    assert before['outputs'] != after['outputs']
+    # ...to exactly the donor's own forward (per-call param reads)
+    want = onp.asarray(donor(nd.array(onp.asarray(
+        [[1, 2, 3] + [0] * 5], 'int32'))).asnumpy())[0, :3]
+    assert onp.allclose(onp.asarray(after['outputs']), want, atol=1e-6)
+
+
+def test_reload_invalid_step_is_409(served, tmp_path):
+    _net, _eng, srv = served
+    srv.replica_root = str(tmp_path)
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/reload',
+                                {'ns': 'serving', 'step': 3})
+    assert st == 409, doc
+
+
+def test_drain_stops_admission_and_listener(served):
+    _net, eng, srv = served
+    st, doc = serving.http_json('127.0.0.1', srv.port, '/drain', {})
+    assert st == 200 and doc['draining']
+    deadline = time.monotonic() + 10.0
+    while srv._server is not None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv._server is None, "drain never closed the listener"
+    with pytest.raises(serving.RequestShed):
+        eng.submit([1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# router: failover, ejection, readmission
+# ---------------------------------------------------------------------------
+
+def _dead_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+def test_router_fails_over_and_ejects(served):
+    _net, _eng, srv = served
+    dead = _dead_port()
+    r = serving.Router(endpoints=[('127.0.0.1', dead),
+                                  ('127.0.0.1', srv.port)],
+                       eject_failures=1, readmit_seconds=60.0)
+    outs = [r.predict([1, 2, 3]) for _ in range(4)]
+    assert all(len(o) == 3 for o in outs)
+    assert r.failovers >= 1
+    assert 0 in r.ejected()              # the dead endpoint is out
+    assert telemetry.value('mxnet_tpu_serving_ejections_total',
+                           rank=0) >= 1
+
+
+def test_router_4xx_is_the_callers_fault_no_ejection(served):
+    _net, _eng, srv = served
+    r = serving.Router(endpoints=[('127.0.0.1', srv.port)],
+                       eject_failures=1)
+    with pytest.raises(MXNetError):
+        r.predict(list(range(99)))       # too long -> 400
+    assert r.ejected() == []             # the replica keeps its seat
+
+
+def test_router_no_replicas():
+    r = serving.Router(endpoints=[])
+    with pytest.raises(serving.NoReplicasError):
+        r.predict([1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# name-stable lowering (the PR 15 churn fix this PR roots out):
+# differently-auto-named identical blocks share ONE persistent cache
+# entry — gluon prefixes must never reach the compiled program key
+# ---------------------------------------------------------------------------
+
+def _cache_files(cache):
+    return len([f for f in glob.glob(os.path.join(cache, '**'),
+                                     recursive=True) if os.path.isfile(f)])
+
+
+def test_cachedop_cache_key_is_prefix_free(tmp_path):
+    cache = str(tmp_path / 'xla_cache')
+    comp.clear(cache_dir=cache)
+    x = nd.array(onp.random.randn(4, 8).astype('float32'))
+    a = nn.Dense(16, in_units=8)
+    a.initialize()
+    a.hybridize()
+    a(x)
+    n1 = _cache_files(cache)
+    b = nn.Dense(16, in_units=8)       # auto-naming bumps the prefix
+    b.initialize()
+    b.hybridize()
+    assert b.name != a.name
+    b(x)
+    n2 = _cache_files(cache)
+    assert n1 >= 1, "cache never wrote"
+    assert n2 == n1, f"prefix churned the compiled-program key: {n1}->{n2}"
+
+
+def test_train_step_cache_key_is_prefix_free(tmp_path):
+    from mxnet_tpu.parallel import ShardedTrainStep
+    cache = str(tmp_path / 'xla_cache')
+    comp.clear(cache_dir=cache)
+    # batch 8: the step shards over the conftest's 8-device CPU mesh
+    x = nd.array(onp.random.randn(8, 8).astype('float32'))
+    y = nd.array(onp.random.randn(8, 4).astype('float32'))
+
+    def build():
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        net(x)
+        return ShardedTrainStep(net, lambda o, t: (o - t) ** 2,
+                                optimizer='sgd',
+                                optimizer_params={'learning_rate': 0.01})
+
+    s1 = build()
+    s1(x, y)
+    n1 = _cache_files(cache)
+    s2 = build()                        # different auto prefix
+    assert s2.block.name != s1.block.name
+    s2(x, y)
+    n2 = _cache_files(cache)
+    assert n1 >= 1, "step never wrote the cache"
+    assert n2 == n1, f"prefix churned the step program key: {n1}->{n2}"
+
+
+# ---------------------------------------------------------------------------
+# the two-process drain drill (acceptance path)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # duplicated by the dryrun_multichip serving stage
+def test_serving_drain_drill_two_replicas(tmp_path):
+    """SIGTERM one replica mid-storm: zero failed requests (router
+    fails over), the departure drops it from the membership-discovered
+    set (MTTR measured), zero post-warmup recompiles on either replica,
+    the second replica's warmup rides the first's persistent cache, and
+    a weight push + /reload lands on the survivor."""
+    from mxnet_tpu.resilience.drill import run_serving_drill
+    out = run_serving_drill(str(tmp_path))
+    assert out['ok'] and out['failed'] == 0
+    assert out['mttr_seconds'] < 10.0
+    assert out['warmup'][2]['cache']['hits'] > 0
+    assert out['reloaded_step'] == 7

@@ -52,6 +52,7 @@ def test_ssd_hybridize_parity():
         onp.testing.assert_allclose(e, h, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~50 s: the heaviest single compile in the suite
 def test_ssd_train_step_decreases_loss():
     rng = onp.random.RandomState(0)
     net = _tiny_ssd()

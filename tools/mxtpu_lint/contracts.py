@@ -230,6 +230,31 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_checkpoint_scrub_repaired_total': 'counter',
         'mxnet_tpu_checkpoint_scrub_seconds': 'histogram',
     },
+    'mxnet_tpu_serving_': {
+        # inference serving (ISSUE 17): the continuous-batching engine's
+        # throughput counters (requests admitted, batches dispatched,
+        # per-bucket hit counts) and its live queue depth
+        'mxnet_tpu_serving_requests_total': 'counter',
+        'mxnet_tpu_serving_batches_total': 'counter',
+        'mxnet_tpu_serving_bucket_hits_total': 'counter',
+        'mxnet_tpu_serving_queue_depth': 'gauge',
+        # batch quality + latency: fill ratio (rows occupied / bucket
+        # capacity — padding waste is 1 - fill) and end-to-end request
+        # latency through the engine
+        'mxnet_tpu_serving_batch_fill_ratio': 'histogram',
+        'mxnet_tpu_serving_latency_seconds': 'histogram',
+        # load shedding (queue overflow / admission control / OOM guard,
+        # by reason) and lifecycle events: replicas that completed a
+        # graceful drain, router-side ejections (by rank)
+        'mxnet_tpu_serving_shed_total': 'counter',
+        'mxnet_tpu_serving_drained_replicas_total': 'counter',
+        'mxnet_tpu_serving_ejections_total': 'counter',
+        # AOT warmup: bucket-grid size pre-built at startup and the wall
+        # seconds the pass cost (near-zero when the persistent XLA cache
+        # is warm)
+        'mxnet_tpu_serving_warmup_buckets': 'gauge',
+        'mxnet_tpu_serving_warmup_seconds': 'gauge',
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -272,6 +297,9 @@ SPAN_NAMES = frozenset({
     # interpolated as f'compile.{phase}' — the static rule checks
     # literals, the phase set is declared here)
     'compile.build', 'compile.trace', 'compile.lower', 'compile.backend',
+    # inference serving (ISSUE 17): the batched bucket dispatch and the
+    # server-side predict window (parse -> batch -> respond)
+    'serving.dispatch', 'serving.predict',
 })
 
 # ---------------------------------------------------------------------------
@@ -307,6 +335,11 @@ FLIGHT_NOTE_NAMES = frozenset({
     # note naming the churning signature axis, and the persistent-cache
     # hit marker with ledger-estimated saved seconds
     'compile.recompiled', 'compile.cache_hit',
+    # inference serving (ISSUE 17): shed decisions (with reason), the
+    # engine watchdog's stuck-request marker, replica drain/reload
+    # lifecycle, router ejections, and fleet-wide weight pushes
+    'serving.shed', 'serving.stuck', 'serving.drain', 'serving.reload',
+    'serving.eject', 'serving.weight_push',
 })
 
 # ---------------------------------------------------------------------------
