@@ -516,3 +516,79 @@ register('MXTPU_SERVE_READMIT_SECONDS', float, 5.0,
 register('MXTPU_SERVE_DRAIN_SECONDS', float, 10.0,
          'Graceful-drain budget: how long a draining replica waits '
          'for in-flight requests to flush before closing.')
+
+# -- kernel autotuning + remat policy (ISSUE 18) ---------------------------
+
+register('MXTPU_FA_G', int, 0,
+         'Explicit flash-attention FORWARD head-group size (the G '
+         'batch*head slices one kernel invocation processes). Highest '
+         'rung of the ops/autotune precedence ladder: env override > '
+         'tuning-DB winner > built-in defaults. 0 (default) = unset; '
+         'the value is still clamped to a divisor of batch*heads and '
+         'to the scoped-VMEM budget.')
+register('MXTPU_FA_BQ', int, 0,
+         'Explicit flash-attention forward query-sequence block size. '
+         '0 = unset (tuning DB, then defaults). Must satisfy the '
+         'Mosaic trailing-tile rule (multiple of 8 rows for f32, 16 '
+         'for bf16) — autotune.check_candidate validates shapes.')
+register('MXTPU_FA_BK', int, 0,
+         'Explicit flash-attention forward key-sequence block size. '
+         '0 = unset (tuning DB, then defaults).')
+register('MXTPU_FA_BWD_G', int, 0,
+         'Explicit flash-attention BACKWARD head-group size (the dq '
+         'and dk/dv kernels). 0 = unset; same clamps as MXTPU_FA_G.')
+register('MXTPU_FA_BWD_BQ', int, 0,
+         'Explicit flash-attention backward query block size. '
+         '0 = unset (tuning DB, then defaults).')
+register('MXTPU_FA_BWD_BK', int, 0,
+         'Explicit flash-attention backward key block size. '
+         '0 = unset (tuning DB, then defaults).')
+register('MXTPU_AUTOTUNE_DIR', str, '',
+         'Directory of the kernel-autotuner tuning DB '
+         '(mxtpu_autotune.json, atomic JSON keyed by device kind + '
+         'kernel + shape signature). When set, _block_sizes consults '
+         'the DB winner for each kernel instance (env overrides still '
+         'win); populate it with tools/tune_bert_step.py --autotune or '
+         'ops.autotune.sweep_flash_attention(). Empty (default): DB '
+         'lookups off, built-in defaults apply.')
+register('MXTPU_AUTOTUNE_REPS', int, 5,
+         'Measured-sweep repetitions per candidate: each surviving '
+         'block-shape candidate is AOT-compiled once (compile time '
+         'excluded, phases recorded in the compile ledger) and timed '
+         'this many times; the median decides the winner.')
+register('MXTPU_PALLAS_FFN', _bool, False,
+         'Route the BERT FFN1 GELU+bias epilogue through the fused '
+         'Pallas matmul kernel (ops/pallas_ffn.py) when a TPU is '
+         'present and the hidden/intermediate dims are multiples of '
+         '128. Default: the XLA path (flag-gated until measured '
+         'on-chip, like MXTPU_PALLAS_LN).')
+
+
+def _remat_policy(s):
+    """MXTPU_REMAT value -> policy name: none (save everything XLA
+    wants), layer (save only matmul outputs), aggressive (save nothing
+    — recompute the whole forward in backward)."""
+    raw = str(s).strip().lower()
+    if raw in ('', '0', 'off', 'false', 'no', 'n', 'none', 'disabled'):
+        return 'none'
+    if raw in ('layer', '1', 'on', 'true', 'yes', 'y'):
+        return 'layer'
+    if raw in ('aggressive', 'full', '2'):
+        return 'aggressive'
+    raise ValueError(f"MXTPU_REMAT={s!r}: expected none (default), "
+                     f"layer, or aggressive")
+
+
+register('MXTPU_REMAT', _remat_policy, 'none',
+         "Rematerialization policy of the sharded train step's forward "
+         "(parallel/step.py): 'none' (default) keeps XLA's own choice "
+         'of saved activations (under ZeRO-3 the gathered params are '
+         'still always recomputed, never kept); '
+         "'layer' wraps the forward in jax.checkpoint saving only "
+         'matmul outputs without batch dims '
+         '(dots_with_no_batch_dims_saveable — the classic per-layer '
+         "checkpoint spend: ~1 extra forward of FLOPs for O(layers) "
+         "activation memory); 'aggressive' saves nothing "
+         '(nothing_saveable — minimum HBM, maximum recompute). '
+         'Sweep + HBM cross-validation: tools/tune_bert_step.py '
+         '--autotune.')

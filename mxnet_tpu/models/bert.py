@@ -74,7 +74,12 @@ class BertLayer(HybridBlock):
     def forward(self, x, mask=None):
         attn = self.attention(x, mask)
         x = self._add_ln(self.ln1, x, attn)
-        h = nd.activation(self.ffn1(x), act_type='gelu')
+        # FFN1 matmul + bias + GELU through one op so the fused Pallas
+        # epilogue can take it when MXTPU_PALLAS_FFN=1 (ops/nn.py
+        # dense_gelu; the XLA default is the same Dense+gelu math)
+        from ..ops import nn as _nn_ops
+        h = _invoke(_nn_ops.dense_gelu, x, self.ffn1.weight.data(),
+                    self.ffn1.bias.data())
         h = self.dropout(self.ffn2(h))
         return self._add_ln(self.ln2, x, h)
 
@@ -277,8 +282,7 @@ def bert_pipeline_funcs(model: 'BertForPretraining', n_stages,
                                              dropout_p=0.0)
         attn = attn @ lp['proj_w'].T + lp['proj_b']
         x = F.layer_norm(x + attn, lp['ln1_g'], lp['ln1_b'], eps=eps)
-        h = F.activation(x @ lp['ffn1_w'].T + lp['ffn1_b'],
-                         act_type='gelu')
+        h = F.dense_gelu(x, lp['ffn1_w'], lp['ffn1_b'])
         h = h @ lp['ffn2_w'].T + lp['ffn2_b']
         return F.layer_norm(x + h, lp['ln2_g'], lp['ln2_b'], eps=eps)
 

@@ -320,6 +320,23 @@ def add_layer_norm(x, res, gamma, beta, eps=1e-5):
     return layer_norm(x + res, gamma, beta, eps=eps)
 
 
+def dense_gelu(x, weight, bias):
+    """FFN1 GELU+bias epilogue: gelu(x @ W.T + b) through one seam so
+    the fused Pallas matmul kernel (ops/pallas_ffn.py) can take it when
+    MXTPU_PALLAS_FFN=1 and a TPU is present; default is the XLA path —
+    identical math to Dense + activation('gelu') (flag-gated until
+    measured on-chip, like MXTPU_PALLAS_LN and the attention knobs)."""
+    from .. import config as _config
+    if _config.get('MXTPU_PALLAS_FFN'):
+        from .pallas_ffn import fused_dense_gelu, pallas_available
+        if pallas_available() and x.shape[-1] % 128 == 0 \
+                and weight.shape[0] % 128 == 0:
+            return fused_dense_gelu(x, weight, bias)
+    return activation(fully_connected(x, weight, bias,
+                                      num_hidden=weight.shape[0],
+                                      flatten=False), act_type='gelu')
+
+
 @_reg
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     """Ref: src/operator/nn/group_norm.cc; input NC+spatial."""

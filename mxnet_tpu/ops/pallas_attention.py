@@ -85,10 +85,15 @@ def _block_sizes(BH, Tq, Tk, D, dtype, kind='fwd'):
     ~6 live (bq, bk) f32 temporaries (s, p, dp, ds, keep, pv) vs the
     forward's ~3 — at (512, 512) blocks that alone is 6MB and the dk/dv
     kernel blows Mosaic's 16MB scoped-VMEM stack limit, so backward
-    defaults to 256-wide blocks. Env overrides for tuning:
-    MXTPU_FA_{G,BQ,BK} (forward) and MXTPU_FA_BWD_{G,BQ,BK}."""
-    import os
-    pre = 'MXTPU_FA_BWD_' if kind == 'bwd' else 'MXTPU_FA_'
+    defaults to 256-wide blocks.
+
+    The defaults computed here are only the LAST rung of the ISSUE 18
+    precedence ladder, applied by ops/autotune.resolve: explicit env
+    override (registered MXTPU_FA_{G,BQ,BK} / MXTPU_FA_BWD_* knobs) >
+    tuning-DB winner (MXTPU_AUTOTUNE_DIR, keyed by device kind +
+    shape signature) > these defaults — with the divisor/VMEM clamps
+    applied to whatever won, and the decision recorded for the
+    compile-ledger signature."""
     min_sub = 16 if dtype == jnp.bfloat16 else 8
     cap = 512 if kind == 'fwd' else 256
     bq = max(min_sub, min(cap, Tq))
@@ -98,27 +103,9 @@ def _block_sizes(BH, Tq, Tk, D, dtype, kind='fwd'):
         if BH % cand == 0:
             G = cand
             break
-    bq = int(os.environ.get(pre + 'BQ', bq))
-    bk = int(os.environ.get(pre + 'BK', bk))
-    genv = os.environ.get(pre + 'G')
-    if genv is not None:
-        # clamp to a divisor of BH: a non-divisor G would leave BH % G
-        # head slices outside the grid with uninitialized outputs
-        G = max(1, min(int(genv), BH))
-        while BH % G:
-            G -= 1
-    # scoped-VMEM guard (limit 16MB): double-buffered io blocks + scratch
-    # accumulators + live (bq, bk) f32 stack temporaries, ~14MB budget.
-    # Each reduction steps to the next smaller DIVISOR of BH — a
-    # non-divisor G would leave BH % G head slices outside the grid.
-    n_tmp = 3 if kind == 'fwd' else 6
-    while G > 1 and (2 * G * (bq + 2 * bk) * D * 4
-                     + G * (bq + bk) * (D + 256) * 4
-                     + n_tmp * bq * bk * 4) > 14 * 2**20:
-        G -= 1
-        while BH % G:
-            G -= 1
-    return G, bq, bk
+    from . import autotune
+    return autotune.resolve(autotune.KERNEL_FA, BH, Tq, Tk, D,
+                            jnp.dtype(dtype), kind, default=(G, bq, bk))
 
 
 # ---------------------------------------------------------------------------

@@ -364,6 +364,11 @@ class ShardedTrainStep:
         # survivor world's dp degree.
         self._requested_stage = stage
         self.zero_stage = stage if dp_size > 1 else 0
+        # MXTPU_REMAT (ISSUE 18): activation-remat policy for the
+        # forward, read once at construction so the build signature and
+        # the checkpoint seam agree for this step's lifetime
+        from .. import config as _remat_cfg
+        self._remat_policy = _remat_cfg.get('MXTPU_REMAT')
         self._spans_processes = self._mesh_spans_processes()
         self.zero = self.zero_stage > 0
         self._params = None       # list[(name, Parameter)]
@@ -670,12 +675,40 @@ class ShardedTrainStep:
                 return forward_loss(gather_all(t_params), f_params,
                                     inputs, labels, key, fault_scale)
 
-            loss_forward = jax.checkpoint(
-                forward_sharded,
-                policy=jax.checkpoint_policies.save_any_names_but_these(
-                    'zero3_gather'))
+            loss_base = forward_sharded
+            # ZeRO-3 floor: whatever the remat policy, the gathered
+            # params are NEVER kept as autodiff residuals
+            base_policy = \
+                jax.checkpoint_policies.save_any_names_but_these(
+                    'zero3_gather')
         else:
-            loss_forward = forward_loss
+            loss_base = forward_loss
+            base_policy = None
+
+        # MXTPU_REMAT (ISSUE 18): parameterized activation remat of the
+        # forward. 'none' keeps the historical behavior bit-for-bit
+        # (checkpoint only as the ZeRO-3 gather-drop floor above);
+        # 'layer' saves only matmul outputs without batch dims — the
+        # classic per-layer checkpoint trade (~1 extra forward of FLOPs
+        # for O(layers) activation HBM; the gathers stay dropped since
+        # an all-gather is not a dot); 'aggressive' saves nothing.
+        # Remat never changes values, only what backward recomputes —
+        # tests assert loss parity across all three policies, and
+        # memory_analysis() cross-validates the HBM deltas.
+        remat = self._remat_policy
+        if remat == 'layer':
+            loss_forward = jax.checkpoint(
+                loss_base,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif remat == 'aggressive':
+            loss_forward = jax.checkpoint(
+                loss_base,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        elif base_policy is not None:
+            loss_forward = jax.checkpoint(loss_base, policy=base_policy)
+        else:
+            loss_forward = loss_base
 
         guard_on = self._guard is not None
 
@@ -962,6 +995,7 @@ class ShardedTrainStep:
                           for k, v in dict(self.mesh.shape).items()}
         except Exception:
             mesh_shape = None
+        from ..ops import autotune as _autotune
         return _compile.signature(args=args, flags={
             'zero': self._zero_label,
             'codec': self.compression['type']
@@ -970,6 +1004,12 @@ class ShardedTrainStep:
             'donate': bool(self.donate),
             'params': len(self._t_names or ()) + len(self._f_names or ()),
             'mesh': mesh_shape,
+            'remat': self._remat_policy,
+            # kernel block shapes the Pallas calls in this program
+            # resolved to (env/db/default) — ISSUE 18: a DB-sourced
+            # shape change is then a visible churn axis in the ledger,
+            # not a silent recompile
+            'autotune': _autotune.decision_flags() or None,
         })
 
     def __call__(self, inputs, labels, lr=None):
@@ -1122,7 +1162,12 @@ class ShardedTrainStep:
                 in_datas, lab_datas, key, lr_val, fault_scale)
         if cctx is not None:
             # the first dispatch returned: XLA's lower + backend compile
-            # are done — close the ledger window before step bookkeeping
+            # are done. Re-stamp the signature first: the lazy trace ran
+            # inside the dispatch above, so any Pallas block-size
+            # decisions (autotune.resolve) only exist NOW — the pre-trace
+            # stamp in the build branch had 'autotune': None.
+            _compile.set_signature(
+                cctx, self._build_signature(in_datas, lab_datas))
             _compile.end(cctx)
         if self._guard is not None:
             new_t, new_f, new_master, new_state, new_residual, loss, ok \

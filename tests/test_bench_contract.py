@@ -175,6 +175,56 @@ def test_serving_report_fleet_respects_child_deadline(bench, monkeypatch):
     assert rep['fleet'] == {'skipped': 'child deadline too close'}
 
 
+def test_autotune_report_contract(bench, monkeypatch, tmp_path):
+    """The "autotune" field (ISSUE 18): the stubbed sweep's winner
+    lands in the report AND the consumption round trip resolves a
+    fresh _block_sizes call to the persisted DB winner (source db) —
+    the same path the compile-ledger signature records in training."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import autotune
+
+    def fake_sweep(db_dir, heads=12, seq=512, head_dim=64):
+        sig = autotune.shape_sig(heads, seq, seq, head_dim,
+                                 jnp.dtype(jnp.float32), 'fwd')
+        autotune.record_winner(autotune.KERNEL_FA, sig, (2, 256, 128),
+                               {'source': 'measured'}, dir_=db_dir)
+        return {'mode': 'measured', 'sweep_seconds': 1.2,
+                'fwd': {'winner': [2, 256, 128], 'source': 'measured',
+                        'candidates': 9, 'pruned': 3,
+                        'signature': sig}}
+
+    monkeypatch.setattr(bench, '_run_autotune_sweep', fake_sweep)
+    monkeypatch.delenv('BENCH_CHILD_DEADLINE', raising=False)
+    monkeypatch.delenv('MXTPU_AUTOTUNE_DIR', raising=False)
+    autotune.clear()
+    try:
+        rep = bench._autotune_report()
+    finally:
+        autotune.clear()
+    assert rep['mode'] == 'measured'
+    assert rep['fwd']['winner'] == [2, 256, 128]
+    assert rep['consumed']['blocks'] == [2, 256, 128]
+    assert any(v.startswith('db:')
+               for v in rep['consumed']['decisions'].values())
+    # the temp DB dir must not leak into the process env
+    import os as _os
+    assert 'MXTPU_AUTOTUNE_DIR' not in _os.environ
+
+
+def test_autotune_report_respects_child_deadline(bench, monkeypatch):
+    """Too little left on the child budget: the sweep is skipped, never
+    started — the flagship metric's deadline wins (the compile-A/B
+    contract)."""
+    def boom(*_a, **_k):
+        raise AssertionError("sweep must not run under a tight deadline")
+    monkeypatch.setattr(bench, '_run_autotune_sweep', boom)
+    monkeypatch.setenv('BENCH_CHILD_DEADLINE',
+                       str(bench.time.time() + 60))
+    rep = bench._autotune_report()
+    assert rep == {'skipped': 'child deadline too close'}
+
+
 def test_total_failure_fallback_carries_error(bench, capsys, monkeypatch):
     """Only when NO metric line could be produced does top-level
     "error" appear — and it names the measurement failures, with probe

@@ -255,6 +255,17 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_serving_warmup_buckets': 'gauge',
         'mxnet_tpu_serving_warmup_seconds': 'gauge',
     },
+    'mxnet_tpu_autotune_': {
+        # Pallas kernel autotuner (ISSUE 18): candidates rejected by the
+        # static Mosaic legality / VMEM-budget check vs. candidates that
+        # made it to the compile+time stage, the wall seconds a sweep
+        # cost, and tuning-DB consultation outcomes from _block_sizes
+        'mxnet_tpu_autotune_candidates_pruned_total': 'counter',
+        'mxnet_tpu_autotune_candidates_timed_total': 'counter',
+        'mxnet_tpu_autotune_sweep_seconds_total': 'counter',
+        'mxnet_tpu_autotune_db_hits_total': 'counter',
+        'mxnet_tpu_autotune_db_misses_total': 'counter',
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -300,6 +311,9 @@ SPAN_NAMES = frozenset({
     # inference serving (ISSUE 17): the batched bucket dispatch and the
     # server-side predict window (parse -> batch -> respond)
     'serving.dispatch', 'serving.predict',
+    # kernel autotuner (ISSUE 18): one sweep = enumerate legal
+    # candidates -> compile+time survivors -> persist the winner
+    'autotune.sweep',
 })
 
 # ---------------------------------------------------------------------------
