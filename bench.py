@@ -751,6 +751,97 @@ def _autotune_report(timeout=120.0):
     return out
 
 
+def _run_sparse_drill(hot_fractions=(1.0, 0.1, 0.02), vocab=20000,
+                      dim=32, batch=64, seq=8, steps=3):
+    """One dense-vs-sparse embedding drill (module-level so the
+    contract tests stub it): build one sparse and one dense step over
+    the same wide-table model, then at each hot fraction draw batches
+    from the first ``hot_fraction * vocab`` rows and time both paths.
+    Returns the sweep rows plus the sparse step's analytic report."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ShardedTrainStep
+
+    def loss_fn(out, label):
+        return (out - label) ** 2
+
+    lab_np = onp.random.RandomState(1).randn(
+        batch, seq, 8).astype('float32')
+    warm_np = onp.random.RandomState(2).randint(
+        0, vocab, size=(batch, seq)).astype('float32')
+
+    def build(sparse):
+        # the step builds lazily on its first call, so the env knob
+        # must still hold when the warmup step runs — warm up here,
+        # inside the knob's scope (also moves compile off the timers)
+        os.environ['MXTPU_SPARSE'] = '1' if sparse else '0'
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Embedding(vocab, dim, sparse_grad=True))
+        net.add(nn.Dense(8, flatten=False))
+        net.initialize()
+        step = ShardedTrainStep(net, loss_fn, 'adam',
+                                {'learning_rate': 0.01})
+        step(nd.array(warm_np), nd.array(lab_np)).asnumpy()
+        return step
+
+    prev = os.environ.get('MXTPU_SPARSE')
+    try:
+        s_step = build(True)
+        d_step = build(False)
+        lab = nd.array(lab_np)
+        sweep = []
+        for frac in hot_fractions:
+            hot = max(1, int(vocab * frac))
+            rng = onp.random.RandomState(3)
+            row = {'hot_fraction': frac}
+            for tag, st in (('sparse', s_step), ('dense', d_step)):
+                times = []
+                for _ in range(steps):
+                    ids = nd.array(rng.randint(
+                        0, hot, size=(batch, seq)).astype('float32'))
+                    t0 = time.perf_counter()
+                    st(ids, lab).asnumpy()
+                    times.append((time.perf_counter() - t0) * 1e3)
+                row[f'{tag}_p50_ms'] = sorted(times)[len(times) // 2]
+            stats = getattr(s_step, '_sparse_prev_stats', None) or {}
+            live = sum(int(v) for v in stats.values())
+            row['live_rows'] = live
+            row['update_bytes'] = live * dim * 4
+            row['dedup_ratio'] = round(batch * seq / max(1, live), 2)
+            sweep.append(row)
+        return {'report': s_step.sparse_report(), 'sweep': sweep}
+    finally:
+        if prev is None:
+            os.environ.pop('MXTPU_SPARSE', None)
+        else:
+            os.environ['MXTPU_SPARSE'] = prev
+
+
+def _sparse_report():
+    """The ``"sparse"`` field (ISSUE 19): update-bytes/step and step
+    time, sparse vs dense, across hot-fraction sweeps — the RowSparse
+    fast path's shrink measured end to end on the live step."""
+    child_deadline = float(os.environ.get('BENCH_CHILD_DEADLINE', '0'))
+    if child_deadline and child_deadline - time.time() < 90:
+        return {'skipped': 'child deadline too close'}
+    drill = _run_sparse_drill()
+    rep = drill['report'] or {}
+    return {
+        'mode': rep.get('mode'),
+        'tables': rep.get('tables'),
+        'update_bytes_per_step': rep.get('update_bytes_per_step'),
+        'dense_update_bytes_per_step':
+            rep.get('dense_update_bytes_per_step'),
+        'update_shrink': rep.get('update_shrink'),
+        'exchange_bytes_per_hop': rep.get('exchange_bytes_per_hop'),
+        'sweep': drill['sweep'],
+    }
+
+
 def _memory_report(step, run_step, steps=4):
     """The ``"memory"`` field (ISSUE 14): live/peak watermark over a few
     sampled steps (the backend allocator's ``memory_stats`` where it
@@ -1160,6 +1251,15 @@ def _child(mode: str) -> None:
     except Exception as e:
         out["autotune"] = {"error": repr(e)[:300]}
         _log(f"autotune report failed: {e!r}")
+    print(json.dumps(out), flush=True)
+    # sparse embeddings (ISSUE 19): update-bytes + step-time shrink of
+    # the RowSparse fast path across hot-fraction sweeps
+    try:
+        out["sparse"] = _sparse_report()
+        _log(f"sparse report: {out['sparse']}")
+    except Exception as e:
+        out["sparse"] = {"error": repr(e)[:300]}
+        _log(f"sparse report failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 

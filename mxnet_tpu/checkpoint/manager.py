@@ -450,6 +450,15 @@ class CheckpointManager:
                 # residuals deterministically reseed to zero) are
                 # auditable from the manifest alone
                 layout['compression'] = dict(comp)
+            sp = getattr(tr, 'sparse_layout', None)
+            sp = sp() if callable(sp) else None
+            if sp:
+                # RowSparse fast path (ISSUE 19): record update mode
+                # (lazy/exact), table-shard axis and per-table row
+                # budgets. Provenance only — sparse state tensors stay
+                # table-shaped, so dense<->sparse and cross-dp restores
+                # need no conversion
+                layout['sparse'] = sp
             meta.setdefault('optimizer_state_layout', layout)
         return {'step': int(step), 'arrays': arrays, 'blobs': blobs,
                 'rng': rng, 'metadata': meta}

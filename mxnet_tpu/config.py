@@ -592,3 +592,37 @@ register('MXTPU_REMAT', _remat_policy, 'none',
          '(nothing_saveable — minimum HBM, maximum recompute). '
          'Sweep + HBM cross-validation: tools/tune_bert_step.py '
          '--autotune.')
+
+# sparse embedding fast path (ISSUE 19) — parallel/step.py RowSparse
+# gradients + live-rows-only optimizer updates
+register('MXTPU_SPARSE', _bool, True,
+         'Enable the RowSparse fast path in the sharded train step: '
+         "parameters declared grad_stype='row_sparse' (Embedding("
+         'sparse_grad=True)) backpropagate (unique row ids, row-block '
+         'values) instead of a dense table-shaped gradient, and the '
+         'optimizer updates only the gathered live rows inside the one '
+         'pjit step. Off: such tables fall back to the dense path '
+         '(identical trajectories under exact mode, see '
+         'MXTPU_SPARSE_EXACT).')
+register('MXTPU_SPARSE_ROWS', int, 0,
+         'Per-table live-row budget ceiling for the sparse fast path. '
+         "A table whose worst-case unique-row budget (min(batch ids, "
+         'vocab), discovered at trace time) exceeds this falls back to '
+         'the dense path — the sparse win only exists when the budget '
+         'is well under the vocab. 0 (default) = no ceiling.')
+register('MXTPU_SPARSE_EXACT', _bool, False,
+         'Force EXACT (non-lazy) sparse semantics: the deduped row '
+         'block densifies into a table-shaped gradient and the regular '
+         'dense optimizer kernel runs — bit-identical trajectories to '
+         'the dense path (the parity oracle; ref lazy_update=False). '
+         'Default off = lazy semantics per the reference: momentum/'
+         'Adam moments of absent rows stay frozen and weight decay '
+         'applies only to live rows.')
+register('MXTPU_SPARSE_TABLE_AXIS', str, '',
+         "Mesh axis name to model-parallel-shard row_sparse embedding "
+         "tables over (e.g. 'tp'): the table rows shard P(axis) and "
+         'XLA inserts the all-to-all feature exchange for ids that '
+         'hash to remote shards. Tables whose vocab does not divide '
+         'the axis extent keep a replicated compute copy and shard '
+         "only their fp32 state over ZeRO's flat padded stores. "
+         'Empty (default) = tables replicate like other params.')

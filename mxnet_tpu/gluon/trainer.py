@@ -91,6 +91,28 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def sparse_layout(self):
+        """RowSparse layout of the eager update path for the checkpoint
+        manifest (``optimizer_state_layout.sparse``), mirroring
+        ShardedTrainStep.sparse_layout: None when no parameter carries
+        a ``row_sparse`` gradient; otherwise the update mode (lazy when
+        the optimizer dispatches lazy row updates) and the (vocab, dim)
+        of every sparse-grad table. Provenance only — state tensors
+        stay table-shaped either way."""
+        tables = {}
+        for p in self._params:
+            if p._grad_stype != 'row_sparse':
+                continue
+            shape = tuple(p.shape or ())
+            if len(shape) == 2:
+                tables[p.name] = {'vocab': int(shape[0]),
+                                  'dim': int(shape[1])}
+        if not tables:
+            return None
+        lazy = bool(getattr(self._optimizer, 'lazy_update', False))
+        return {'mode': 'lazy' if lazy else 'exact',
+                'table_axis': None, 'tables': tables}
+
     def _compression_requested(self):
         return self._compression_params is not None and \
             self._compression_params.get('type', '2bit') != 'none'

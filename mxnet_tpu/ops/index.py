@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import register_op
+from . import rowsparse as _rowsparse
 
 __all__ = []
 
@@ -23,6 +24,13 @@ def _reg(fn):
 def take(a, indices, axis=0, mode='clip'):
     idx = indices.astype(jnp.int32)
     jmode = {'clip': 'clip', 'wrap': 'wrap', 'raise': 'clip'}[mode]
+    if axis == 0 and a.ndim >= 2 and idx.size > 0:
+        # table-style gather: dedup repeated ids so the backward
+        # segment-sums into one row block per unique id before the
+        # table-shaped scatter (ref TakeOpBackward row_sparse path)
+        if jmode == 'wrap':
+            idx = idx % a.shape[0]
+        return _rowsparse.dedup_take(a, idx)
     return jnp.take(a, idx, axis=axis, mode=jmode)
 
 

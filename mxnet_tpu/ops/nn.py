@@ -21,6 +21,7 @@ from jax import lax
 
 from ..base import register_op, MXNetError, state
 from .. import random as _random
+from . import rowsparse as _rowsparse
 
 __all__ = []
 
@@ -406,9 +407,21 @@ def dropout(data, p=0.5, mode='training', axes=(), cudnn_off=False):
 def embedding(data, weight, input_dim=0, output_dim=0, dtype='float32',
               sparse_grad=False):
     """Ref: src/operator/tensor/indexing_op.cc Embedding; a gather that XLA
-    turns into a dynamic-slice — rows stay in HBM, no host round-trip."""
+    turns into a dynamic-slice — rows stay in HBM, no host round-trip.
+
+    Backward dedups repeated ids via segment-sum before the table-shaped
+    scatter (ref EmbeddingOpBackwardEx / AddTakeGradRspKernel) instead of
+    scatter-adding one row slice per occurrence. When parallel/step.py has
+    armed a RowSparse capture for this table (matched by trace identity),
+    the lookup also records live row ids so the optimizer can update only
+    the gathered rows."""
     idx = data.astype(jnp.int32)
-    return jnp.take(weight, idx, axis=0)
+    slot = _rowsparse.lookup_capture(weight)
+    if slot is not None:
+        return slot.lookup(idx)
+    if weight.ndim == 2 and idx.size > 0:
+        return _rowsparse.dedup_take(weight, idx)
+    return jnp.take(weight, idx, axis=0, mode='clip')
 
 
 @_reg

@@ -1,0 +1,227 @@
+"""RowSparse gradient kernels: id dedup + live-row lookup capture.
+
+The reference treats ``row_sparse`` as a first-class gradient storage
+type (ref: src/operator/tensor/indexing_op.cc EmbeddingOpBackwardEx,
+``include/mxnet/ndarray.h kRowSparseStorage``): an Embedding/take
+backward produces (unique row ids, row-block values) and the optimizer
+touches only those rows. On the XLA path the same structure falls out
+of a *dedup-first* lookup::
+
+    uids, inv = unique_rows(ids)         # sort -> segment boundaries
+    rows = weight[uids]                  # gather unique rows once
+    out  = rows[inv]                     # fan back out to every slot
+
+whose transpose segment-sums the per-occurrence cotangents into one
+row block per unique id (the ``.at[inv].add`` scatter) BEFORE anything
+touches table-shaped storage — the reference's AddTakeGradRspKernel
+dedup, for free from autodiff.
+
+Everything here is pure jnp over static shapes (jit/pjit safe). The
+sentinel for unused slots in the fixed-size ``uids`` buffer is
+``vocab`` (one past the last row): gathers clip it harmlessly and
+scatters DROP it under jit (XLA out-of-bounds scatter semantics), so a
+``.at[uids].set(rows)`` updates exactly the live rows.
+
+``trace_capture`` is the seam ``parallel/step.py`` arms while tracing
+the model forward: an ``embedding(..., sparse_grad=True)`` lookup on a
+captured table routes through the dedup lookup, adds the step's
+per-table row tangent (the differentiated leaf whose cotangent IS the
+RowSparse row block), and records the live ids for the optimizer.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['unique_rows', 'dedup_take', 'merge_row_blocks',
+           'trace_capture', 'lookup_capture']
+
+
+def unique_rows(flat_ids, budget, vocab):
+    """Dedup a flat int vector of row ids into a fixed-size buffer.
+
+    Returns ``(uids, inv, n_live)``:
+
+    - ``uids``: ``(budget,)`` int32 — the unique ids in ascending
+      order, padded with the sentinel ``vocab`` (slots past
+      ``n_live``);
+    - ``inv``: ``(flat_ids.size,)`` int32 — position of each input id
+      inside ``uids`` (``uids[inv] == clip(flat_ids)``);
+    - ``n_live``: ``()`` int32 — how many slots are real.
+
+    ``budget`` must be static and >= the worst-case unique count
+    (``min(flat_ids.size, vocab)`` is always safe — the caller sizes
+    the buffer once at trace time, so the program shape never depends
+    on the batch's actual id distribution).
+    """
+    ids = jnp.clip(flat_ids.reshape(-1).astype(jnp.int32), 0, vocab - 1)
+    # value sort + searchsorted, NOT argsort + inverse-permutation
+    # scatter: the variadic (key, iota) sort that argsort lowers to is
+    # miscompiled by the GSPMD sort partitioner on multi-axis meshes
+    # when the ids arrive batch-sharded (dp x tp CPU meshes produce
+    # NaN losses once forward and backward compile together)
+    sorted_ids = jnp.sort(ids)
+    # segment boundaries of the sorted run -> dense unique-slot index
+    first = jnp.concatenate([
+        jnp.ones((1,), jnp.int32),
+        (sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(first) - 1
+    n_live = seg[-1] + 1
+    uids = jnp.full((budget,), vocab, jnp.int32).at[seg].set(
+        sorted_ids, mode='drop')
+    # every (clipped) id is present in uids and uids is ascending with
+    # the sentinel past the live prefix, so the insertion point IS the
+    # unique-slot index
+    inv = jnp.searchsorted(uids, ids).astype(jnp.int32)
+    return uids, inv, n_live
+
+
+def dedup_take(a, indices, vocab=None):
+    """``jnp.take(a, indices, axis=0, mode='clip')`` through the
+    dedup-first lookup: forward values are bit-identical to the plain
+    gather, backward segment-sums repeated ids into one row block
+    before the table-shaped scatter (instead of scatter-adding one
+    slice per occurrence)."""
+    vocab = int(a.shape[0]) if vocab is None else int(vocab)
+    idx = indices.astype(jnp.int32)
+    n = int(idx.size)
+    if n == 0 or vocab == 0:
+        return jnp.take(a, idx, axis=0, mode='clip')
+    budget = min(n, vocab)
+    uids, inv, _ = unique_rows(idx, budget, vocab)
+    rows = jnp.take(a, uids, axis=0, mode='clip')
+    out = jnp.take(rows, inv, axis=0)
+    return out.reshape(tuple(idx.shape) + tuple(a.shape[1:]))
+
+
+def merge_row_blocks(uids, values, vocab, budget=None):
+    """Merge possibly-overlapping ``(uids, row values)`` blocks (e.g.
+    two lookups of the same table in one step) into one deduped block:
+    duplicate ids segment-sum their rows; sentinel slots stay zero.
+    ``budget`` defaults to ``min(uids.size, vocab)``."""
+    uids = uids.reshape(-1)
+    values = values.reshape((uids.shape[0],) + tuple(values.shape[1:]))
+    if budget is None:
+        budget = min(int(uids.shape[0]), int(vocab))
+    # sentinel entries (uid == vocab) sort last; their merged group
+    # either lands past the budget (scatter-dropped) or keeps the
+    # sentinel uid (update-dropped) — their values are zero either way
+    muids, minv, _ = unique_rows(uids, budget, vocab + 1)
+    muids = jnp.minimum(muids, vocab)
+    merged = jnp.zeros((budget,) + tuple(values.shape[1:]),
+                       values.dtype).at[minv].add(values, mode='drop')
+    n_live = jnp.sum((muids < vocab).astype(jnp.int32))
+    return muids, merged, n_live
+
+
+# ---------------------------------------------------------------------------
+# trace-time capture: parallel/step.py arms a context keyed by the
+# identity of each sparse table's traced array; the embedding op checks
+# it and routes captured lookups through the dedup + tangent path.
+# Thread-local so concurrent traces (tests build steps from several
+# threads) never see each other's tables.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+class _TableSlot:
+    """Per-table capture state for ONE trace."""
+
+    def __init__(self, name, array, vocab, dim, tangent=None,
+                 budgets=None):
+        self.name = name
+        self.array = array
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.tangent = tangent          # (sum(budgets), dim) or None
+        self.budgets = list(budgets or [])   # per-lookup row budgets
+        self.call_sizes = []            # discover mode: flat id counts
+        self.uids = []                  # per-lookup (budget,) id vectors
+        self.n_live = []                # per-lookup live counts
+        self._offset = 0
+
+    def lookup(self, idx):
+        n = int(idx.size)
+        if self.tangent is None:
+            # discover mode: record the lookup's id count; plain gather
+            # keeps shapes flowing without needing a budget yet
+            self.call_sizes.append(n)
+            return jnp.take(self.array, idx, axis=0, mode='clip')
+        k = len(self.uids)
+        budget = self.budgets[k] if k < len(self.budgets) \
+            else min(n, self.vocab)
+        uids, inv, n_live = unique_rows(idx, budget, self.vocab)
+        # stop_gradient: the table itself must receive NO table-shaped
+        # cotangent — the row tangent added below is the only
+        # differentiated leaf, and its cotangent is the deduped
+        # RowSparse row block the optimizer consumes
+        rows = jnp.take(jax.lax.stop_gradient(self.array), uids,
+                        axis=0, mode='clip')
+        rows = rows.astype(self.tangent.dtype) \
+            + self.tangent[self._offset:self._offset + budget]
+        self._offset += budget
+        self.uids.append(uids)
+        self.n_live.append(n_live)
+        out = jnp.take(rows, inv, axis=0).astype(self.array.dtype)
+        return out.reshape(tuple(idx.shape) + (self.dim,))
+
+
+class _Capture:
+    def __init__(self, slots):
+        self.slots = slots                       # name -> _TableSlot
+        self.by_id = {id(s.array): s for s in slots.values()}
+
+    def __enter__(self):
+        stack = getattr(_TLS, 'stack', None)
+        if stack is None:
+            stack = _TLS.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.stack.pop()
+        return False
+
+    def results(self):
+        """{name: {'uids': (budget,) int32, 'n_live': () int32}} with
+        multi-lookup tables concatenated (the update side re-dedups
+        via merge_row_blocks)."""
+        out = {}
+        for n, s in self.slots.items():
+            if not s.uids:
+                continue
+            out[n] = {
+                'uids': jnp.concatenate(s.uids) if len(s.uids) > 1
+                else s.uids[0],
+                'n_live': sum(s.n_live[1:], s.n_live[0]),
+            }
+        return out
+
+
+def trace_capture(tables, tangents=None, budgets=None):
+    """Arm a capture for one trace of the model forward.
+
+    ``tables``: {name: traced table array (vocab, dim)};
+    ``tangents``: {name: (sum(budgets), dim) zero tangent} or None for
+    discover mode (record per-lookup id counts only);
+    ``budgets``: {name: [per-lookup row budget, ...]}.
+    """
+    slots = {}
+    for n, arr in tables.items():
+        slots[n] = _TableSlot(
+            n, arr, arr.shape[0], arr.shape[1],
+            tangent=None if tangents is None else tangents[n],
+            budgets=None if budgets is None else budgets.get(n))
+    return _Capture(slots)
+
+
+def lookup_capture(weight):
+    """The armed table slot for ``weight`` (matched by trace identity)
+    or None — the hook ``ops.nn.embedding`` checks on every call."""
+    stack = getattr(_TLS, 'stack', None)
+    if not stack:
+        return None
+    return stack[-1].by_id.get(id(weight))

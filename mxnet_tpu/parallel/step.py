@@ -53,6 +53,7 @@ poisoned step still skips on device with the residual writeback gated.
 from __future__ import annotations
 
 import functools
+from contextlib import nullcontext
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,7 @@ from ..resilience import faults as _faults
 from ..telemetry import trace as _trace, flight as _flight, \
     memory as _memory, compile as _compile
 from .. import random as _random
+from ..ops import rowsparse as _rowsparse
 from . import compression as _compression
 from .collectives import group_params_by_layer, ordered_barrier
 from .mesh import default_mesh
@@ -332,6 +334,14 @@ class ShardedTrainStep:
         self.optimizer_params = dict(optimizer_params or {})
         self.lr = self.optimizer_params.pop('learning_rate',
                                             self.optimizer_params.pop('lr', 0.01))
+        # reference Optimizer(lazy_update=...): lazy (default) updates
+        # only the live rows of row_sparse-grad params inside the step;
+        # False forces the exact densified path (bit-identical to dense
+        # training — the parity oracle, like MXTPU_SPARSE_EXACT)
+        self._lazy_sparse = bool(self.optimizer_params.pop(
+            'lazy_update', True))
+        self._sparse_names = []
+        self._sparse_prev_stats = None
         if optimizer not in _OPTS:
             raise ValueError(f"ShardedTrainStep supports {sorted(_OPTS)}")
         self._opt_init, self._opt_update = _OPTS[optimizer]
@@ -513,7 +523,7 @@ class ShardedTrainStep:
         n_inputs = len(example_inputs)
 
         def forward_loss(t_params, f_params, inputs, labels, key,
-                         fault_scale):
+                         fault_scale, row_tangents=None):
             all_params = dict(t_params)
             all_params.update(f_params)
             name_to_param = dict(trainable + frozen)
@@ -521,10 +531,23 @@ class ShardedTrainStep:
             for n, p in name_to_param.items():
                 proxies[n] = NDArray(all_params[n])
                 p._set_trace_proxy(proxies[n])
+            # RowSparse capture (ISSUE 19): armed INSIDE this function —
+            # which jax.checkpoint re-traces during backward — so the
+            # table identities the embedding op matches on are always
+            # the CURRENT trace's tracers. Each captured lookup routes
+            # through the dedup-first gather, adds its slice of the
+            # zero row tangent (whose cotangent IS the RowSparse row
+            # block), and records the live ids for the optimizer.
+            cap = None
+            if row_tangents is not None:
+                cap = _rowsparse.trace_capture(
+                    {n: all_params[n] for n in row_tangents},
+                    tangents=row_tangents, budgets=sparse_budgets)
             prev = _flags.is_training
             _flags.is_training = True
             try:
-                with _random.key_provider(_random.TraceKeyProvider(key)):
+                with _random.key_provider(_random.TraceKeyProvider(key)), \
+                        (cap if cap is not None else nullcontext()):
                     out = block.forward(*[NDArray(x) for x in inputs])
                     outs = out if isinstance(out, (list, tuple)) else (out,)
                     loss = loss_fn(*outs, *[NDArray(l) for l in labels])
@@ -539,7 +562,104 @@ class ShardedTrainStep:
             # like BERT included
             loss_val = jnp.mean(loss._data) * fault_scale
             aux = {n: proxies[n]._data for n in f_names}
+            if cap is not None:
+                return loss_val, (aux, cap.results())
             return loss_val, aux
+
+        # ------------------------------------------------------------------
+        # RowSparse fast path (ISSUE 19): parameters declared
+        # grad_stype='row_sparse' (Embedding(sparse_grad=True)) carry
+        # (unique row ids, row-block values) gradients and live-rows-only
+        # optimizer updates. Budgets — the static worst-case unique-row
+        # counts per lookup — are discovered with one abstract
+        # jax.eval_shape trace (no compile, no FLOPs) before the real
+        # program is built.
+        from .. import config as _cfg
+        sparse_on = bool(_cfg.get('MXTPU_SPARSE'))
+        sparse_exact = bool(_cfg.get('MXTPU_SPARSE_EXACT')) \
+            or not self._lazy_sparse
+        sparse_cap = int(_cfg.get('MXTPU_SPARSE_ROWS'))
+        table_axis = str(_cfg.get('MXTPU_SPARSE_TABLE_AXIS') or '') or None
+        name_to_p = dict(trainable)
+        s_candidates = [
+            n for n, p in trainable
+            if getattr(p, '_grad_stype', 'default') == 'row_sparse'
+            and len(tuple(p.data().shape)) == 2]
+        sparse_budgets = {}          # name -> [per-lookup row budget]
+        sparse_id_counts = {}        # name -> flat ids per step (pre-dedup)
+        if sparse_on and s_candidates:
+            discovered = {}
+
+            def _discover(t_params, f_params, inputs, labels, key,
+                          fault_scale):
+                cap = _rowsparse.trace_capture(
+                    {n: t_params[n] for n in s_candidates})
+                with cap:
+                    forward_loss(t_params, f_params, inputs, labels,
+                                 key, fault_scale)
+                for cn, slot in cap.slots.items():
+                    discovered[cn] = list(slot.call_sizes)
+                return jnp.zeros(())
+
+            t_avals = {n: jax.ShapeDtypeStruct(
+                tuple(p.data().shape), p.data()._data.dtype)
+                for n, p in trainable}
+            f_avals = {n: jax.ShapeDtypeStruct(
+                tuple(p.data().shape), p.data()._data.dtype)
+                for n, p in frozen}
+            jax.eval_shape(
+                _discover, t_avals, f_avals,
+                tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                      for x in example_inputs),
+                tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                      for x in example_labels),
+                jax.random.PRNGKey(0), jnp.float32(1.0))
+            for n in s_candidates:
+                sizes = discovered.get(n) or []
+                if not sizes:
+                    continue     # never looked up through embedding
+                vocab = int(name_to_p[n].data().shape[0])
+                buds = [min(s, vocab) for s in sizes]
+                if sparse_cap and sum(buds) > sparse_cap:
+                    continue     # budget over ceiling: dense fallback
+                sparse_budgets[n] = buds
+                sparse_id_counts[n] = int(sum(sizes))
+        s_names = sorted(sparse_budgets)
+        self._sparse_names = s_names
+        self._sparse_budgets = sparse_budgets
+        self._sparse_id_counts = sparse_id_counts
+        self._sparse_exact = sparse_exact
+        # model-parallel table sharding: a divisible vocab shards
+        # P(table_axis) and XLA inserts the all-to-all feature exchange
+        # for remote rows; ragged vocabularies keep the replicated
+        # compute copy (their fp32 state still shards through ZeRO-3's
+        # flat padded stores)
+        self._sparse_table_axis = None
+        sparse_table_sharded = set()
+        if table_axis and s_names:
+            if table_axis in (self.dp_axis, self._shard_axis,
+                              self._cross_axis):
+                raise MXNetError(
+                    f"MXTPU_SPARSE_TABLE_AXIS={table_axis!r} collides "
+                    f"with the data-parallel axis — pick a model "
+                    f"axis (e.g. 'tp').")
+            tshape = dict(zip(self.mesh.axis_names,
+                              self.mesh.devices.shape))
+            tsize = int(tshape.get(table_axis, 0))
+            if tsize > 1:
+                for n in s_names:
+                    vocab = int(name_to_p[n].data().shape[0])
+                    if vocab % tsize == 0 and \
+                            self._spec_for(n) == P():
+                        self._spec_map[n] = P(table_axis)
+                        sparse_table_sharded.add(n)
+                if sparse_table_sharded:
+                    self._sparse_table_axis = table_axis
+        self._sparse_sig = {
+            'mode': 'exact' if sparse_exact else 'lazy',
+            'table_axis': self._sparse_table_axis,
+            'tables': {n: int(sum(sparse_budgets[n])) for n in s_names},
+        } if s_names else None
 
         # shardings. The batch shards over the FULL dp extent either
         # way; ZeRO layouts shard over the intra-host sub-axis when the
@@ -671,9 +791,10 @@ class ShardedTrainStep:
                 return gathered
 
             def forward_sharded(t_params, f_params, inputs, labels, key,
-                                fault_scale):
+                                fault_scale, row_tangents=None):
                 return forward_loss(gather_all(t_params), f_params,
-                                    inputs, labels, key, fault_scale)
+                                    inputs, labels, key, fault_scale,
+                                    row_tangents)
 
             loss_base = forward_sharded
             # ZeRO-3 floor: whatever the remat policy, the gathered
@@ -714,16 +835,121 @@ class ShardedTrainStep:
 
         def train_step(t_params, f_params, master, opt_state, residual,
                        inputs, labels, key, lr, fault_scale):
-            (loss_val, aux), grads = jax.value_and_grad(
-                loss_forward, has_aux=True)(t_params, f_params, inputs,
-                                            labels, key, fault_scale)
+            if s_names:
+                # RowSparse tables ride as zero tangents: the embedding
+                # lookup adds tangent[live-row slice] to the gathered
+                # rows (the table itself is stop_gradient-ed in the
+                # capture), so d loss/d tangent IS the deduped row-block
+                # gradient — no table-shaped cotangent ever exists
+                tangents = {n: jnp.zeros(
+                    (sum(sparse_budgets[n]), shapes[n][1]), jnp.float32)
+                    for n in s_names}
+                (loss_val, (aux, srec)), (grads, g_rows) = \
+                    jax.value_and_grad(
+                        loss_forward, argnums=(0, 6), has_aux=True)(
+                            t_params, f_params, inputs, labels, key,
+                            fault_scale, tangents)
+            else:
+                (loss_val, aux), grads = jax.value_and_grad(
+                    loss_forward, has_aux=True)(t_params, f_params,
+                                                inputs, labels, key,
+                                                fault_scale)
+                srec, g_rows = {}, {}
             new_params = {}
             new_master = {}
             new_state = {}
             new_residual = {}
+            sparse_stats = {}
             ok = jnp.isfinite(loss_val) if guard_on else None
             for n in t_names:
-                g32 = grads[n].astype(jnp.float32)
+                srn = srec.get(n)
+                if srn is not None:
+                    vocab, dim = shapes[n]
+                    uids = srn['uids']
+                    rows = g_rows[n].astype(jnp.float32)
+                    if len(sparse_budgets[n]) > 1:
+                        # several lookups of the same table in one step:
+                        # segment-sum overlapping ids into one block
+                        uids, rows, n_live = _rowsparse.merge_row_blocks(
+                            uids, rows, vocab)
+                    else:
+                        n_live = srn['n_live']
+                    sparse_stats[n] = n_live
+                    if not sparse_exact:
+                        # lazy update (reference lazy_update=True /
+                        # kvstore row_sparse semantics): gather the live
+                        # rows of master + moments, run the SAME
+                        # optimizer kernel on the (budget, dim) block,
+                        # scatter back. Sentinel slots (uid == vocab)
+                        # gather a clipped garbage row whose writeback
+                        # XLA's OOB scatter DROPS — dead slots never
+                        # touch the table. Moments of absent rows stay
+                        # frozen; wd applies to live rows only.
+                        fz = flat_meta.get(n)
+                        if fz is not None:
+                            # zero3 flat padded store: a row is a
+                            # contiguous dim-slice of the 1-D buffer
+                            fidx = (uids[:, None] * dim + jnp.arange(
+                                dim, dtype=jnp.int32)[None, :])
+
+                            def _rget(a, fidx=fidx):
+                                return jnp.take(a, fidx, mode='clip')
+
+                            def _rset(a, r, fidx=fidx):
+                                return a.at[fidx].set(r, mode='drop')
+                        else:
+                            def _rget(a, uids=uids):
+                                return jnp.take(a, uids, axis=0,
+                                                mode='clip')
+
+                            def _rset(a, r, uids=uids):
+                                return a.at[uids].set(r, mode='drop')
+                        if comp_on:
+                            # error-feedback codec on the ROW BLOCK with
+                            # per-row scales (block = dim); the residual
+                            # stays table-shaped and persistent — only
+                            # live rows accumulate/flush error
+                            acc = rows + _rget(residual[n])
+                            dec = _compression.encode_decode(
+                                acc, ctype, cthreshold, dim)
+                            new_residual[n] = _rset(residual[n],
+                                                    acc - dec)
+                            rows = dec
+                        if guard_on:
+                            ok = jnp.logical_and(
+                                ok, jnp.all(jnp.isfinite(rows)))
+                        if n in master_names:
+                            p32 = master[n]
+                        else:
+                            p32 = t_params[n].astype(jnp.float32)
+                        p_rows = _rget(p32)
+                        s_rows = tuple(_rget(s) if s.ndim else s
+                                       for s in opt_state[n])
+                        nr_, nsr_ = opt_update(p_rows, rows, s_rows, lr,
+                                               **opt_kwargs)
+                        np_ = _rset(p32, nr_)
+                        new_state[n] = tuple(
+                            _rset(s, sr) if s.ndim else sr
+                            for s, sr in zip(opt_state[n], nsr_))
+                        if fz is not None:
+                            new_params[n] = np_[:fz['size']].reshape(
+                                shapes[n]).astype(t_params[n].dtype)
+                            new_master[n] = np_
+                        else:
+                            new_params[n] = np_.astype(t_params[n].dtype)
+                            if n in master_names:
+                                new_master[n] = np_
+                        continue
+                    # exact mode: densify the deduped block into a
+                    # table-shaped grad and run the regular dense path —
+                    # bit-identical trajectories to dense training (the
+                    # parity oracle). The WIRE exchange still happened
+                    # on row blocks (the tangent cotangent), only the
+                    # local update is dense.
+                    g32 = jnp.zeros((vocab, dim), jnp.float32) \
+                        .at[uids].add(rows, mode='drop')
+                else:
+                    g32 = grads[n].astype(jnp.float32)
                 fz = flat_meta.get(n)
                 zsh = shard_constraint.get(n)
                 if fz is not None:
@@ -793,10 +1019,17 @@ class ShardedTrainStep:
                                 for n, nr in new_residual.items()}
                 new_f = {n: jnp.where(ok, new_f[n], f_params[n])
                          for n in f_names}
-                return (new_params, new_f, new_master, new_state,
+                outs = (new_params, new_f, new_master, new_state,
                         new_residual, loss_val, ok)
-            return (new_params, new_f, new_master, new_state,
-                    new_residual, loss_val)
+            else:
+                outs = (new_params, new_f, new_master, new_state,
+                        new_residual, loss_val)
+            if s_names:
+                # per-table live-row counts as a last (replicated)
+                # output — the telemetry side reads them one step
+                # deferred, never stalling the dispatch
+                outs = outs + (sparse_stats,)
+            return outs
         # Name-stable jit boundary: the pytree dict keys of every param
         # container land in the lowered module's arg metadata and hence
         # the persistent XLA cache key. gluon's auto-naming counter
@@ -837,6 +1070,9 @@ class ShardedTrainStep:
                          _enc(residual_shardings), repl)
         if guard_on:
             out_shardings = out_shardings + (repl,)
+        if s_names:
+            out_shardings = out_shardings + (
+                {alias[n]: repl for n in s_names},)
         donate = (0, 2, 3, 4) if self.donate else ()
         self._compiled = jax.jit(stable_step, in_shardings=in_shardings,
                                  out_shardings=out_shardings,
@@ -898,6 +1134,17 @@ class ShardedTrainStep:
             b, c = hop_plan.get((kind, axis), (0.0, 0))
             hop_plan[(kind, axis)] = (b + nbytes, c + cnt)
 
+        # RowSparse side ledger: per-hop sparse wire bytes and the
+        # dense-equivalent bytes the same exchange would have moved —
+        # the measurable shrink sparse_report()/dryrun assert on
+        sparse_hop = {}
+        sparse_dense_hop = {}
+
+        def _sadd(axis, nbytes, dense_nbytes):
+            sparse_hop[axis] = sparse_hop.get(axis, 0.0) + nbytes
+            sparse_dense_hop[axis] = \
+                sparse_dense_hop.get(axis, 0.0) + dense_nbytes
+
         param_nbytes = {}
         for n, p in trainable:
             size = int(onp.prod(p.data().shape)) if p.data().shape else 1
@@ -921,7 +1168,40 @@ class ShardedTrainStep:
             else:
                 continue
             # the gradient exchange itself
-            if hier:
+            if n in s_names:
+                # RowSparse exchange: the wire carries (int32 ids +
+                # row-block values) instead of the table-shaped grad —
+                # exchange bytes scale with the live-row budget, not the
+                # vocab. Exact mode densifies LOCALLY after the row
+                # exchange, so the wire shrink holds for both modes;
+                # only the lazy codec re-encodes the rows (per-row
+                # scales, block = dim) for the cross-host hop.
+                B = sum(sparse_budgets[n])
+                dim = shapes[n][1]
+                row_raw = B * (dim * 4 + 4)
+                row_enc = (_compression.wire_bytes((B, dim), ctype, dim)
+                           + B * 4) if comp_on and not sparse_exact \
+                    else row_raw
+                if hier:
+                    if h > 1:
+                        _add('reduce_scatter', intra_axis,
+                             ring * row_raw, 1)
+                        _sadd(intra_axis, ring * row_raw,
+                              ring * grad_raw)
+                    cross_enc = 2 * ring_h * row_enc / h
+                    _add('all_reduce', cross_axis, cross_enc, 1)
+                    _sadd(cross_axis, cross_enc,
+                          2 * ring_h * (enc if comp_on else grad_raw)
+                          / h)
+                    comp_raw += 2 * ring_h * row_raw / h
+                    comp_enc += cross_enc
+                else:
+                    _add('all_reduce', intra_axis, 2 * ring * row_enc, 1)
+                    _sadd(intra_axis, 2 * ring * row_enc,
+                          2 * ring * (enc if comp_on else grad_raw))
+                    comp_raw += 2 * ring * row_raw
+                    comp_enc += 2 * ring * row_enc
+            elif hier:
                 if h > 1:
                     _add('reduce_scatter', intra_axis, ring * grad_raw, 1)
                 cross_raw = 2 * ring_h * grad_raw / h
@@ -940,8 +1220,24 @@ class ShardedTrainStep:
                 _add('all_reduce', intra_axis, 2 * ring * wire, 1)
                 comp_raw += 2 * ring * grad_raw
                 comp_enc += 2 * ring * wire
+        # table-axis feature exchange (model-parallel tables): the
+        # forward gathers remote rows and the backward scatters their
+        # updates — one all-to-all pair per step, bytes proportional to
+        # the live-row budget in the compute dtype (+ the id vector)
+        for n in sparse_table_sharded:
+            tsize = int(dict(zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape))[table_axis])
+            B = sum(sparse_budgets[n])
+            dim = shapes[n][1]
+            itemsize = jnp.dtype(
+                name_to_p[n].data()._data.dtype).itemsize
+            a2a = 2 * _ring(tsize) * B * (dim * itemsize + 4)
+            _add('all_to_all', table_axis, a2a, 2)
+            _sadd(table_axis, a2a, a2a)
         self._comm_plan = plan
         self._hop_plan = hop_plan
+        self._sparse_hop = sparse_hop
+        self._sparse_dense_hop = sparse_dense_hop
         self._comp_plan = {
             'codec': ctype, 'raw_bytes': comp_raw, 'encoded_bytes':
             comp_enc, 'axis': cross_axis if hier else intra_axis,
@@ -1005,6 +1301,10 @@ class ShardedTrainStep:
             'params': len(self._t_names or ()) + len(self._f_names or ()),
             'mesh': mesh_shape,
             'remat': self._remat_policy,
+            # RowSparse fast path (ISSUE 19): mode + per-table row
+            # budgets — a batch-shape change that moves a budget is a
+            # legitimate recompile, and the ledger should say why
+            'sparse': getattr(self, '_sparse_sig', None),
             # kernel block shapes the Pallas calls in this program
             # resolved to (env/db/default) — ISSUE 18: a DB-sourced
             # shape change is then a visible churn axis in the ledger,
@@ -1169,6 +1469,10 @@ class ShardedTrainStep:
             _compile.set_signature(
                 cctx, self._build_signature(in_datas, lab_datas))
             _compile.end(cctx)
+        sparse_stats = None
+        if self._sparse_names:
+            sparse_stats = self._alias_dec(out[-1])
+            out = out[:-1]
         if self._guard is not None:
             new_t, new_f, new_master, new_state, new_residual, loss, ok \
                 = out
@@ -1231,6 +1535,41 @@ class ShardedTrainStep:
                         self._comp_plan['encoded_bytes'],
                         codec=self._comp_plan['codec'],
                         axis=self._comp_plan['axis'])
+        if sparse_stats is not None:
+            prev_stats = self._sparse_prev_stats
+            self._sparse_prev_stats = sparse_stats
+            if _trace.enabled():
+                for axis, nbytes in (self._sparse_hop or {}).items():
+                    _trace.instant('sparse.exchange', bytes=int(nbytes),
+                                   axis=axis,
+                                   tables=len(self._sparse_names))
+                _trace.instant(
+                    'optimizer.sparse_update',
+                    mode='exact' if self._sparse_exact else 'lazy',
+                    tables=len(self._sparse_names))
+            if _telem['on']:
+                from .. import telemetry as _telemetry
+                for axis, nbytes in (self._sparse_hop or {}).items():
+                    _telemetry.counter(
+                        'mxnet_tpu_sparse_exchange_bytes_total').inc(
+                            nbytes, axis=axis)
+                if prev_stats is not None:
+                    for n, v in prev_stats.items():
+                        # one-step-deferred host read: the PREVIOUS
+                        # step's scalar has already materialized, so
+                        # this never stalls the step just dispatched
+                        live = int(v)
+                        dim = self._shapes[n][1]
+                        _telemetry.set_gauge(
+                            'mxnet_tpu_sparse_live_rows', live, table=n)
+                        _telemetry.counter(
+                            'mxnet_tpu_sparse_row_bytes_total').inc(
+                                live * dim * 4, table=n)
+                        ids = self._sparse_id_counts.get(n, 0)
+                        if live:
+                            _telemetry.set_gauge(
+                                'mxnet_tpu_sparse_dedup_ratio',
+                                ids / live, table=n)
         loss_nd = NDArray(_local_value(loss))
         _memory.on_step(self._step_count)
         _flight.record_step(self._step_count, loss=loss_nd)
@@ -1551,6 +1890,73 @@ class ShardedTrainStep:
             'residual_bytes_per_device': self.residual_bytes_per_device(),
         }
 
+    def sparse_layout(self):
+        """RowSparse layout description for the checkpoint manifest
+        (``optimizer_state_layout.sparse``): update mode, table-shard
+        axis and per-table (vocab, dim, live-row budget). None before
+        the first build or when no table took the sparse path. The
+        state tensors themselves stay table-shaped (lazy updates touch
+        rows in place), so dense<->sparse and dp=N<->dp=M restores need
+        no layout conversion — this record is provenance, not a
+        decoder requirement."""
+        if not getattr(self, '_sparse_names', None):
+            return None
+        return {
+            'mode': 'exact' if self._sparse_exact else 'lazy',
+            'table_axis': self._sparse_table_axis,
+            'tables': {n: {'vocab': int(self._shapes[n][0]),
+                           'dim': int(self._shapes[n][1]),
+                           'budget': int(sum(self._sparse_budgets[n])),
+                           'ids_per_step':
+                               int(self._sparse_id_counts.get(n, 0))}
+                       for n in self._sparse_names},
+        }
+
+    def sparse_report(self):
+        """Analytic per-step cost of the RowSparse fast path vs the
+        dense path it replaced — None when no table took it.
+
+        - ``update_bytes_per_step``: optimizer-touched bytes (param +
+          fp32 master + vector moments rows) across sparse tables;
+          lazy mode scales with the live-row budget, exact mode is
+          honestly dense (it densifies before the kernel).
+        - ``exchange_bytes_per_hop``: analytic ring-wire bytes of the
+          row-block gradient exchange by mesh hop, with the
+          dense-equivalent bytes the same hop would have moved.
+        """
+        if not getattr(self, '_sparse_names', None):
+            return None
+        tables = {}
+        upd = dense_upd = 0
+        for n in self._sparse_names:
+            vocab, dim = self._shapes[n]
+            budget = min(int(sum(self._sparse_budgets[n])), int(vocab))
+            leaves = 1 + sum(
+                1 for s in self._opt_state[n] if getattr(s, 'ndim', 0))
+            if n in self._master_names:
+                leaves += 1
+            per_row = dim * 4 * leaves
+            touched = vocab if self._sparse_exact else budget
+            tables[n] = {'vocab': int(vocab), 'dim': int(dim),
+                         'budget': budget,
+                         'update_bytes': touched * per_row,
+                         'dense_update_bytes': int(vocab) * per_row}
+            upd += touched * per_row
+            dense_upd += int(vocab) * per_row
+        hops = {axis: {'bytes': int(b),
+                       'dense_bytes':
+                           int(self._sparse_dense_hop.get(axis, 0))}
+                for axis, b in (self._sparse_hop or {}).items()}
+        return {
+            'mode': 'exact' if self._sparse_exact else 'lazy',
+            'table_axis': self._sparse_table_axis,
+            'tables': tables,
+            'update_bytes_per_step': int(upd),
+            'dense_update_bytes_per_step': int(dense_upd),
+            'update_shrink': dense_upd / max(1, upd),
+            'exchange_bytes_per_hop': hops,
+        }
+
     def get_states_bytes(self):
         """Optimizer state as a layout-independent bytes payload: every
         shard is gathered to host fp32 numpy, so a checkpoint written at
@@ -1586,6 +1992,11 @@ class ShardedTrainStep:
             doc['residual'] = {n: self._leaf_to_logical(n, r)
                                for n, r in self._residual.items()}
             doc['compression'] = dict(self.compression)
+        sp = self.sparse_layout()
+        if sp is not None:
+            # provenance only: sparse state tensors are table-shaped,
+            # so restore needs no conversion in either direction
+            doc['sparse'] = sp
         return pickle.dumps(doc)
 
     def set_states_bytes(self, blob):

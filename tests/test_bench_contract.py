@@ -225,6 +225,51 @@ def test_autotune_report_respects_child_deadline(bench, monkeypatch):
     assert rep == {'skipped': 'child deadline too close'}
 
 
+def test_sparse_report_contract(bench, monkeypatch):
+    """The "sparse" field (ISSUE 19): the stubbed drill's analytic
+    report and hot-fraction sweep land in the emitted field — shrink,
+    per-hop exchange bytes, and one sweep row per fraction."""
+    def fake_drill(*_a, **_k):
+        return {
+            'report': {
+                'mode': 'lazy',
+                'tables': {'emb0_weight': {'vocab': 20000, 'dim': 32,
+                                           'budget': 512,
+                                           'ids_per_step': 512}},
+                'update_bytes_per_step': 512 * 32 * 4,
+                'dense_update_bytes_per_step': 20000 * 32 * 4,
+                'update_shrink': 39.06,
+                'exchange_bytes_per_hop': {
+                    'dp': {'bytes': 1024, 'dense_bytes': 40960}},
+            },
+            'sweep': [{'hot_fraction': 0.1, 'sparse_p50_ms': 1.0,
+                       'dense_p50_ms': 3.0, 'live_rows': 400,
+                       'update_bytes': 51200, 'dedup_ratio': 1.28}],
+        }
+
+    monkeypatch.setattr(bench, '_run_sparse_drill', fake_drill)
+    monkeypatch.delenv('BENCH_CHILD_DEADLINE', raising=False)
+    rep = bench._sparse_report()
+    assert rep['mode'] == 'lazy'
+    assert rep['update_shrink'] == 39.06
+    assert rep['dense_update_bytes_per_step'] == 20000 * 32 * 4
+    assert rep['exchange_bytes_per_hop']['dp']['bytes'] == 1024
+    assert rep['sweep'][0]['hot_fraction'] == 0.1
+    assert rep['sweep'][0]['live_rows'] == 400
+
+
+def test_sparse_report_respects_child_deadline(bench, monkeypatch):
+    """Too little left on the child budget: the drill is skipped, never
+    built — the flagship metric's deadline wins."""
+    def boom(*_a, **_k):
+        raise AssertionError("drill must not build under a tight deadline")
+    monkeypatch.setattr(bench, '_run_sparse_drill', boom)
+    monkeypatch.setenv('BENCH_CHILD_DEADLINE',
+                       str(bench.time.time() + 60))
+    rep = bench._sparse_report()
+    assert rep == {'skipped': 'child deadline too close'}
+
+
 def test_total_failure_fallback_carries_error(bench, capsys, monkeypatch):
     """Only when NO metric line could be produced does top-level
     "error" appear — and it names the measurement failures, with probe

@@ -55,11 +55,27 @@ def _tool_stats() -> List:
     return out
 
 
+def _evidence_stats(index: FileIndex) -> List:
+    """(relpath, mtime_ns, size) for non-Python files rules consult as
+    evidence — today just README.md, which knob-drift checks registered
+    knobs against. Without this a README edit that documents (or drops)
+    a knob would replay yesterday's findings from cache."""
+    out = []
+    for rel in ('README.md',):
+        try:
+            st = os.stat(os.path.join(index.root, rel))
+        except OSError:
+            continue
+        out.append((rel, st.st_mtime_ns, st.st_size))
+    return out
+
+
 def cache_key(index: FileIndex, rule_ids) -> str:
     doc = {'version': CACHE_VERSION,
            'pkg': index.pkg_dir,
            'rules': sorted(rule_ids),
            'files': index.file_stats,
+           'evidence': _evidence_stats(index),
            'tool': _tool_stats()}
     raw = json.dumps(doc, sort_keys=True).encode()
     return hashlib.sha256(raw).hexdigest()[:32]

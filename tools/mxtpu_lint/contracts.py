@@ -266,6 +266,17 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_autotune_db_hits_total': 'counter',
         'mxnet_tpu_autotune_db_misses_total': 'counter',
     },
+    'mxnet_tpu_sparse_': {
+        # RowSparse embedding fast path (ISSUE 19): per-table live-row
+        # count of the previous step (host-read one step deferred), the
+        # cumulative row-block gradient payload bytes, the id dedup
+        # factor (flat ids per step / unique live rows), and the
+        # analytic wire bytes of the row-block exchange per mesh hop
+        'mxnet_tpu_sparse_live_rows': 'gauge',
+        'mxnet_tpu_sparse_row_bytes_total': 'counter',
+        'mxnet_tpu_sparse_dedup_ratio': 'gauge',
+        'mxnet_tpu_sparse_exchange_bytes_total': 'counter',
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -314,6 +325,11 @@ SPAN_NAMES = frozenset({
     # kernel autotuner (ISSUE 18): one sweep = enumerate legal
     # candidates -> compile+time survivors -> persist the winner
     'autotune.sweep',
+    # RowSparse embedding fast path (ISSUE 19): per-step instants for
+    # the row-block gradient exchange (analytic wire bytes per hop,
+    # incl. the table-axis all-to-all) and the live-rows-only optimizer
+    # update (mode = lazy | exact)
+    'sparse.exchange', 'optimizer.sparse_update', 'comm.all_to_all',
 })
 
 # ---------------------------------------------------------------------------
