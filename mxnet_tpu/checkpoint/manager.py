@@ -218,6 +218,12 @@ class CheckpointManager:
         self.save_rng = bool(save_rng)
         self.preempted = False
         self._current_step = None
+        # elastic data resharding: an optional provider callable whose
+        # dict (epoch position + per-rank shard assignment, e.g.
+        # io.ElasticShard.state()) rides every manifest under
+        # meta['data'] — see bind_data_state
+        self._data_state = None
+        self.last_restored_metadata = None
         self._last_autosave_time = _time.monotonic()
         self._pending: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -294,6 +300,18 @@ class CheckpointManager:
             old, self._replica = self._replica, replica_manager
         if old is not None and old is not replica_manager:
             old.close()
+
+    # -- data-position state (elastic resharding) --------------------------
+
+    def bind_data_state(self, provider) -> None:
+        """Bind a callable returning the data-position state dict
+        (``io.ElasticShard.state()`` / ``DataLoader.data_state()``)
+        recorded in every manifest under ``metadata['data']`` —
+        alongside the ``world`` metadata, so a re-form at ANY world
+        size resumes the sample stream exactly where the commit left
+        it (no sample dropped or double-seen). Read it back after a
+        restore from ``last_restored_metadata['data']``."""
+        self._data_state = provider
 
     # -- save -------------------------------------------------------------
 
@@ -423,6 +441,17 @@ class CheckpointManager:
             meta.setdefault('world', world)
         except Exception:
             pass
+        if self._data_state is not None:
+            # data-position metadata (elastic resharding): where the
+            # sample stream stood at this commit, plus the per-rank
+            # shard assignment it was drawn under — the restore side
+            # re-partitions the SAME global sequence at the new world
+            try:
+                ds = self._data_state()
+                if ds is not None:
+                    meta.setdefault('data', dict(ds))
+            except Exception:
+                pass
         if 'trainer_states' in blobs and self._trainer is not None:
             # The states payload is ALWAYS host-gathered fp32 (both
             # Trainer.get_states_bytes and ShardedTrainStep gather their
@@ -737,6 +766,11 @@ class CheckpointManager:
         t0 = _time.perf_counter()
         with _trace.span('checkpoint.restore', step=int(step)):
             ck = self._load_step(step)
+        # manifest metadata of the newest restore (world, optimizer
+        # layout, data-position state): apply=True returns only the
+        # step number, but a re-form still needs metadata['data'] to
+        # re-seed its sample stream
+        self.last_restored_metadata = dict(ck.metadata or {})
         if apply:
             target = self._params
             if target is not None:

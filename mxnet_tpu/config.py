@@ -285,6 +285,29 @@ register('MXTPU_BARRIER_TIMEOUT_SECONDS', float, 60.0,
          'Timeout of the elastic membership barrier (dist.barrier): '
          'how long a rank waits for every live peer to arrive at the '
          'same tag before raising.')
+register('MXTPU_JOIN_TIMEOUT_SECONDS', float, 120.0,
+         'Timeout of the elastic scale-up admission rendezvous: how '
+         'long a joiner (after its JOIN announcement) and the '
+         'quiesced survivors wait for each other at the admit barrier '
+         'before the admission is abandoned. Also bounds how long an '
+         'unadmitted JOIN announcement survives on the coordinator '
+         'without joiner heartbeats.')
+register('MXTPU_AUTOSCALE_COOLDOWN_SECONDS', float, 30.0,
+         'Autoscaler hysteresis: minimum spacing between decisions of '
+         'the same kind (per rank for evicts, global for capacity '
+         'requests) so one noisy detector window cannot thrash the '
+         'fleet.')
+register('MXTPU_AUTOSCALE_STRIKES', int, 3,
+         'Autoscaler hysteresis: a FleetMonitor detector flag '
+         '(chronic straggler, memory imbalance, step regression) must '
+         'persist for this many CONSECUTIVE observe() polls before it '
+         'escalates to an evict/request-capacity decision; a cleared '
+         'flag resets the count.')
+register('MXTPU_AUTOSCALE_MAX_WORLD', int, 0,
+         'Upper bound on the world size the autoscaler will request '
+         'capacity toward (its target is clamped to this). 0 '
+         '(default): unbounded — the target is the nominal world '
+         'observed at the first poll.')
 register('MXTPU_CHECKPOINT_REPLICAS', int, 1,
          'Checkpoint survivability: how many PEER hosts each committed '
          'checkpoint step is replicated to over the membership side '

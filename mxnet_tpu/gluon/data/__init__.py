@@ -1,6 +1,7 @@
 from .dataset import (Dataset, SimpleDataset, ArrayDataset,
                       RecordFileDataset)
 from .sampler import (Sampler, SequentialSampler, RandomSampler,
-                      FilterSampler, BatchSampler, IntervalSampler)
+                      FilterSampler, BatchSampler, ElasticSampler,
+                      IntervalSampler)
 from .dataloader import DataLoader
 from . import vision

@@ -177,6 +177,27 @@ class DataLoader:
                 pass
             yield self._result_with_respawn(f, b, i)
 
+    def data_state(self):
+        """Manifest-ready data-position state when the batch sampler is
+        elastic (``ElasticSampler`` / anything with ``state()``), else
+        None. Bind to a CheckpointManager via ``bind_data_state`` so
+        every commit records where the sample stream stood — the half
+        of a re-form that makes resumes exactly-once."""
+        st = getattr(self._batch_sampler, 'state', None)
+        return st() if callable(st) else None
+
+    def reshard(self, rank, world):
+        """Re-partition an elastic batch sampler after a re-form
+        (shrink or grow): same global position, new per-rank block."""
+        rs = getattr(self._batch_sampler, 'reshard', None)
+        if not callable(rs):
+            raise MXNetError(
+                "DataLoader: batch sampler is not elastic (pass "
+                "batch_sampler=ElasticSampler(...) for world-indexed "
+                "deterministic assignment)")
+        rs(rank, world)
+        return self
+
     def close(self):
         if self._pool is not None:
             self._pool.shutdown(wait=False)

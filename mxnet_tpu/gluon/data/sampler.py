@@ -86,6 +86,52 @@ class BatchSampler(Sampler):
                          f"'rollover', but got {self._last_batch}")
 
 
+class ElasticSampler(Sampler):
+    """Batch sampler with world-indexed deterministic sample
+    assignment for elastic data parallelism. Wraps
+    ``io.ElasticShard``: each ``__iter__`` pass yields this rank's
+    block of successive GLOBAL batches (so it plugs into
+    ``DataLoader(batch_sampler=...)``), the global position is stream
+    state that survives ``reset``/re-iteration and round-trips through
+    the checkpoint manifest (``state()``/``from_state``), and
+    ``reshard(rank, world)`` re-partitions the same global sequence
+    after a shrink or grow — no sample dropped or double-seen across
+    any world-size history."""
+
+    def __init__(self, length, global_batch, rank=0, world=1, seed=0,
+                 position=0, shuffle=True, shard=None):
+        from ...io.io import ElasticShard
+        self._shard = shard if shard is not None else ElasticShard(
+            length, global_batch, rank=rank, world=world, seed=seed,
+            position=position, shuffle=shuffle)
+
+    @property
+    def shard(self):
+        return self._shard
+
+    def __iter__(self):
+        for _ in range(len(self)):
+            yield self._shard.next_batch()
+
+    def __len__(self):
+        # batches per pass: one epoch's worth of GLOBAL batches (the
+        # stream itself is unbounded — epoch wrap re-permutes)
+        return max(1, self._shard.num_samples // self._shard.global_batch)
+
+    def reshard(self, rank, world):
+        self._shard.reshard(rank, world)
+        return self
+
+    def state(self):
+        return self._shard.state()
+
+    @classmethod
+    def from_state(cls, state, rank=None, world=None):
+        from ...io.io import ElasticShard
+        return cls(1, 1, shard=ElasticShard.from_state(
+            state, rank=rank, world=world))
+
+
 class IntervalSampler(Sampler):
     def __init__(self, length, interval, rollover=True):
         self._length = length

@@ -1,7 +1,7 @@
-from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, DevicePrefetchIter, CSVIter, MNISTIter,
-                 ImageRecordIter)
+from .io import (DataDesc, DataBatch, DataIter, ElasticShard, NDArrayIter,
+                 ResizeIter, PrefetchingIter, DevicePrefetchIter, CSVIter,
+                 MNISTIter, ImageRecordIter)
 
-__all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'ResizeIter',
-           'PrefetchingIter', 'DevicePrefetchIter', 'CSVIter', 'MNISTIter',
-           'ImageRecordIter']
+__all__ = ['DataDesc', 'DataBatch', 'DataIter', 'ElasticShard',
+           'NDArrayIter', 'ResizeIter', 'PrefetchingIter',
+           'DevicePrefetchIter', 'CSVIter', 'MNISTIter', 'ImageRecordIter']

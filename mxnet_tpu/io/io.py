@@ -166,12 +166,157 @@ class DataIter:
         raise NotImplementedError
 
 
+class ElasticShard:
+    """World-indexed deterministic sample assignment for elastic data
+    parallelism — the data-plane half of scale-down/scale-up re-forms.
+
+    The GLOBAL batch is the unit of progress: every training step
+    consumes exactly ``global_batch`` samples fleet-wide, and rank
+    ``r`` of world ``w`` owns the half-open block ``[r*G/w,
+    (r+1)*G/w)`` of it. The global ``position`` (samples consumed
+    since step 0) therefore advances by ``G`` per step on EVERY rank —
+    a pure function of the step count, independent of the world-size
+    history. Re-sharding after a shrink or grow is just
+    ``reshard(rank, world)`` at the checkpoint-restored position: the
+    new blocks re-partition the same global sequence, so across any
+    shrink→grow chain no sample is dropped or double-seen (the
+    churn-storm drill asserts this sample-for-sample against a
+    fixed-world run).
+
+    Sample order: epoch ``e`` (= ``position // num_samples``) draws a
+    fresh ``RandomState(seed + e)`` permutation when ``shuffle`` is on
+    (identity order otherwise) — deterministic in every process, so
+    ``sample_at(g)`` is a pure function of the global-order index. A
+    batch crossing the epoch boundary takes the tail of one
+    permutation and the head of the next.
+
+    ``state()`` round-trips through the checkpoint manifest
+    (``CheckpointManager.bind_data_state``): it records the epoch
+    position and the per-rank shard assignment alongside the existing
+    ``world`` metadata, which is what makes resumes exactly-once
+    across world changes."""
+
+    def __init__(self, num_samples, global_batch, rank=0, world=1,
+                 seed=0, position=0, shuffle=True):
+        num_samples = int(num_samples)
+        global_batch = int(global_batch)
+        if num_samples <= 0:
+            raise MXNetError("ElasticShard: num_samples must be > 0")
+        if global_batch <= 0:
+            raise MXNetError("ElasticShard: global_batch must be > 0")
+        self.num_samples = num_samples
+        self.global_batch = global_batch
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.position = int(position)
+        self.rank = 0
+        self.world = 1
+        self._perms = {}
+        self.reshard(rank, world)
+
+    def reshard(self, rank, world):
+        """Re-partition the SAME global sequence across a new world:
+        the position is untouched, only this rank's block changes."""
+        rank, world = int(rank), int(world)
+        if world <= 0 or not 0 <= rank < world:
+            raise MXNetError(
+                f"ElasticShard: rank {rank} not in world {world}")
+        if self.global_batch % world:
+            raise MXNetError(
+                f"ElasticShard: global_batch {self.global_batch} not "
+                f"divisible by world {world} — a re-form at that world "
+                f"would drop or double samples")
+        self.rank = rank
+        self.world = world
+        return self
+
+    @property
+    def epoch(self):
+        return self.position // self.num_samples
+
+    @property
+    def batch_size(self):
+        """Per-rank samples per step at the current world."""
+        return self.global_batch // self.world
+
+    def _perm(self, epoch):
+        if not self.shuffle:
+            return None
+        p = self._perms.get(epoch)
+        if p is None:
+            rng = onp.random.RandomState((self.seed + epoch) & 0x7fffffff)
+            p = rng.permutation(self.num_samples)
+            self._perms[epoch] = p
+            # keep only the two epochs a batch can straddle
+            for k in list(self._perms):
+                if k < epoch - 1:
+                    del self._perms[k]
+        return p
+
+    def sample_at(self, g):
+        """Global-order index -> dataset sample id."""
+        e, slot = divmod(int(g), self.num_samples)
+        p = self._perm(e)
+        return int(slot if p is None else p[slot])
+
+    def next_batch(self):
+        """This rank's sample ids of the next global batch, advancing
+        the global position by ``global_batch``."""
+        per = self.global_batch // self.world
+        base = self.position + self.rank * per
+        ids = [self.sample_at(base + j) for j in range(per)]
+        self.position += self.global_batch
+        return ids
+
+    def assignment(self):
+        """{rank: [lo, hi)} — each rank's sample-offset block within
+        every global batch at the current world."""
+        per = self.global_batch // self.world
+        return {str(r): [r * per, (r + 1) * per]
+                for r in range(self.world)}
+
+    def state(self):
+        """Manifest-ready snapshot: epoch position + per-rank shard
+        assignment (see ``CheckpointManager.bind_data_state``)."""
+        return {'position': int(self.position),
+                'epoch': int(self.epoch),
+                'num_samples': int(self.num_samples),
+                'global_batch': int(self.global_batch),
+                'seed': int(self.seed),
+                'shuffle': bool(self.shuffle),
+                'world': int(self.world),
+                'rank': int(self.rank),
+                'assignment': self.assignment()}
+
+    @classmethod
+    def from_state(cls, state, rank=None, world=None):
+        """Rebuild from a manifest-recorded state, optionally
+        re-sharded for a NEW (rank, world) — the restore half of a
+        re-form: the global position survives verbatim, the block
+        assignment re-partitions."""
+        s = dict(state or {})
+        return cls(num_samples=s['num_samples'],
+                   global_batch=s['global_batch'],
+                   rank=s.get('rank', 0) if rank is None else rank,
+                   world=s.get('world', 1) if world is None else world,
+                   seed=s.get('seed', 0),
+                   position=s.get('position', 0),
+                   shuffle=s.get('shuffle', True))
+
+
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (ref: io.py NDArrayIter)."""
+    """Iterate over in-memory arrays (ref: io.py NDArrayIter).
+
+    Pass an ``ElasticShard`` as ``shard`` for elastic data
+    parallelism: the shard then owns the sample order and the per-rank
+    batch size (the ``batch_size``/``shuffle`` arguments are ignored),
+    ``reset()`` starts a new pass WITHOUT rewinding the global
+    position (it is a stream, checkpointed via ``data_state()`` and
+    re-partitioned via ``reshard()`` after a re-form)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle='pad', data_name='data',
-                 label_name='softmax_label'):
+                 label_name='softmax_label', shard=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
@@ -181,6 +326,17 @@ class NDArrayIter(DataIter):
         self.num_data = self.idx.shape[0]
         if last_batch_handle == 'discard':
             self.num_data = (self.num_data // batch_size) * batch_size
+        self.shard = shard
+        if shard is not None:
+            if shard.num_samples != self.idx.shape[0]:
+                raise MXNetError(
+                    f"NDArrayIter: shard covers {shard.num_samples} "
+                    f"samples but the data has {self.idx.shape[0]}")
+            self.batch_size = shard.batch_size
+            self._shard_batches = max(
+                1, self.num_data // shard.global_batch)
+            self._shard_taken = 0
+            self._shard_ids = None
         self.cursor = -batch_size
         self._cache = None
         self.reset()
@@ -198,15 +354,30 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        if self.shard is not None:
+            # a new pass, NOT a rewind: the shard's global position is
+            # the stream state and only checkpoint restore moves it
+            self._shard_taken = 0
+            return
         if self.shuffle:
             onp.random.shuffle(self.idx)
         self.cursor = -self.batch_size
 
     def iter_next(self):
+        if self.shard is not None:
+            if self._shard_taken >= self._shard_batches:
+                return False
+            # draw once per batch: getdata/getlabel must see the SAME
+            # sample ids, and the draw advances the global position
+            self._shard_ids = onp.asarray(self.shard.next_batch())
+            self._shard_taken += 1
+            return True
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
     def _take(self, arrs):
+        if self.shard is not None:
+            return [array(v[self._shard_ids]) for _, v in arrs]
         out = []
         end = self.cursor + self.batch_size
         for _, v in arrs:
@@ -228,10 +399,27 @@ class NDArrayIter(DataIter):
         return self._take(self.label)
 
     def getpad(self):
+        if self.shard is not None:
+            return 0     # epoch wrap re-permutes instead of padding
         end = self.cursor + self.batch_size
         if end > self.num_data:
             return end - self.num_data
         return 0
+
+    def data_state(self):
+        """Manifest-ready data-position state (None without a shard) —
+        bind to a CheckpointManager via ``bind_data_state`` so every
+        commit records where the sample stream stood."""
+        return None if self.shard is None else self.shard.state()
+
+    def reshard(self, rank, world):
+        """Re-partition the sample stream after a re-form (shrink or
+        grow): same global position, new per-rank block."""
+        if self.shard is None:
+            raise MXNetError("NDArrayIter: no ElasticShard attached")
+        self.shard.reshard(rank, world)
+        self.batch_size = self.shard.batch_size
+        return self
 
 
 def _init_data(data, allow_empty, default_name):

@@ -363,6 +363,14 @@ def _elastic_port(coordinator=None):
     return base + 1000
 
 
+# the reserved barrier tag of the scale-up admission rendezvous: its
+# completion set includes the PENDING joiners (not just the alive
+# ranks), and completing it is the admission point — the coordinator
+# promotes every pending joiner into the alive set atomically with the
+# generation bump (see Membership._handle_locked)
+ADMIT_TAG = 'admit'
+
+
 class Membership:
     """Heartbeat-tracked peer membership over a TCP side channel.
 
@@ -434,6 +442,14 @@ class Membership:
         self._last_beat = {r: now for r in range(self.world)}
         self._steps = {}
         self._left = set()
+        # JOIN candidates pending admission (scale-up): rank ->
+        # announcement time, with liveness tracked separately in
+        # _join_beat so a joiner that dies again BEFORE admission is
+        # garbage-collected instead of wedging every future admit
+        # rendezvous. Promotion into _last_beat happens only when the
+        # admission rendezvous (barrier tag ADMIT_TAG) completes.
+        self._joining = {}
+        self._join_beat = {}
         self._barriers = {}           # tag -> {rank: nonce} arrived this gen
         self._barrier_gen = {}        # tag -> completed-rendezvous count
         self._barrier_done = {}       # tag -> {rank: (nonce, gen)} latest
@@ -584,7 +600,13 @@ class Membership:
         r = int(msg.get('rank', -1))
         with self._lock:
             if op == 'beat':
-                self._last_beat[r] = _time.monotonic()
+                if r in self._joining:
+                    # PENDING joiner: liveness only — the rank enters
+                    # the alive set at the admission rendezvous, not by
+                    # heartbeating at the side channel
+                    self._join_beat[r] = _time.monotonic()
+                else:
+                    self._last_beat[r] = _time.monotonic()
                 if msg.get('step') is not None:
                     self._steps[r] = int(msg['step'])
                 if msg.get('telem') is not None:
@@ -593,6 +615,22 @@ class Membership:
                                       'time': _time.time()}
             elif op == 'leave':
                 self._left.add(r)
+            elif op == 'join':
+                # JOIN announcement (scale-up): the rank stays PENDING
+                # — surfaced under view['joining'] so every survivor's
+                # controller quiesces at its next step boundary — and
+                # only the admission rendezvous promotes it into the
+                # alive set. Stale records of a previous incarnation
+                # (LEFT on preemption, LOST on SIGKILL) are discarded
+                # so the rejoiner is not instantly re-declared lost
+                # off a months-old heartbeat timestamp.
+                now = _time.monotonic()
+                self._left.discard(r)
+                self._last_beat.pop(r, None)
+                self._steps.pop(r, None)
+                if r not in self._joining:
+                    self._joining[r] = now
+                self._join_beat[r] = now
             elif op in ('barrier', 'barrier_poll'):
                 # generation-counted rendezvous: a reused tag (kvstore's
                 # fixed 'kvstore', repeated re-forms) must synchronize
@@ -615,12 +653,29 @@ class Membership:
                     else:
                         arrived[r] = nonce
                 view = self._view_locked()
-                if arrived and set(view['alive']) <= \
-                        set(arrived) | self._left:
+                # the ADMISSION rendezvous (tag ADMIT_TAG) completes
+                # only when the pending joiners have arrived TOO — and
+                # completion is the generation-counted admission
+                # point: every pending joiner is promoted into the
+                # alive set atomically with the barrier bump, so the
+                # completed reply's view already shows the larger
+                # world to survivors and joiners alike.
+                need = set(view['alive'])
+                if tag == ADMIT_TAG:
+                    need |= set(self._joining)
+                if arrived and need <= set(arrived) | self._left:
                     self._barrier_gen[tag] = self._barrier_gen[tag] + 1
                     for rr, nn in arrived.items():
                         done[rr] = (nn, self._barrier_gen[tag])
                     arrived.clear()
+                    if tag == ADMIT_TAG and self._joining:
+                        nowm = _time.monotonic()
+                        for rr in list(self._joining):
+                            self._last_beat[rr] = nowm
+                            self._left.discard(rr)
+                        self._joining.clear()
+                        self._join_beat.clear()
+                        view = self._view_locked()
                 view['barrier_gen'] = self._barrier_gen[tag]
                 view['barrier_baseline'] = gen0
                 view['barrier_done'] = self._barrier_gen[tag] > gen0
@@ -629,18 +684,33 @@ class Membership:
                 for x in msg.get('ranks', []):
                     self._left.add(int(x))
                     self._telem.pop(int(x), None)
+                    # a pending JOIN from the removed rank is cancelled
+                    # too (it can re-announce after the re-form)
+                    self._joining.pop(int(x), None)
+                    self._join_beat.pop(int(x), None)
             return self._view_locked()
 
     def _view_locked(self):
         now = _time.monotonic()
+        if self._joining:
+            # GC joiners that went silent again before admission — a
+            # half-finished JOIN must not wedge future rendezvous
+            for r in [r for r, t in self._join_beat.items()
+                      if now - t > self.deadline_seconds]:
+                self._joining.pop(r, None)
+                self._join_beat.pop(r, None)
         ages = {str(r): round(now - t, 3)
                 for r, t in self._last_beat.items() if r not in self._left}
         lost = sorted(int(r) for r, age in ages.items()
                       if age > self.deadline_seconds)
         alive = sorted(int(r) for r in ages if int(r) not in lost)
-        return {'world': len(alive), 'alive': alive, 'ages': ages,
+        view = {'world': len(alive), 'alive': alive, 'ages': ages,
                 'lost': lost, 'left': sorted(self._left),
                 'steps': {str(k): v for k, v in self._steps.items()}}
+        if self._joining:
+            view['joining'] = {str(r): round(now - t, 3)
+                               for r, t in self._joining.items()}
+        return view
 
     # -- sender (every rank) -----------------------------------------------
 
@@ -830,6 +900,35 @@ class Membership:
             pass   # coordinator already gone — nothing to tell
         self._stop.set()
 
+    def join(self):
+        """Announce this rank as a JOIN candidate (a preempted rank
+        coming back, or brand-new capacity granted by the provider).
+        The coordinator marks it PENDING — surfaced in every view under
+        ``joining`` so the survivors' controllers quiesce at their next
+        step boundary — and the admission rendezvous
+        (``barrier(ADMIT_TAG)``) promotes it into the alive set. The
+        ``dist.join`` fault site drills failed/delayed announcements.
+        Returns the coordinator's view."""
+        from ..resilience import faults as _faults
+        _faults.fire('dist.join')
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_elastic_joins_total')
+            from ..telemetry import flight as _flight
+            _flight.note('elastic.join', rank=self.rank)
+        msg = {'op': 'join', 'rank': self.rank}
+        if self.is_coordinator:
+            return self._handle(msg)
+        return self._request(msg)
+
+    def joining(self):
+        """{rank: seconds-since-announcement} of JOIN candidates pending
+        admission (coordinator: computed live; workers: from the last
+        beat reply — at most one heartbeat stale)."""
+        v = self.view()
+        return {int(r): float(a)
+                for r, a in (v or {}).get('joining', {}).items()}
+
     def remove_peers(self, ranks):
         """Retire lost peers from the tracked set (post re-form: the new
         world must not keep re-declaring the same loss)."""
@@ -892,6 +991,10 @@ class Membership:
             now = _time.monotonic()
             self._last_beat = {r: now for r in alive}
             self._left = set()
+            # pending JOINs announced to the dead coordinator are gone
+            # with it — joiners re-announce against the promoted one
+            self._joining = {}
+            self._join_beat = {}
             self._last_ok = now
         self.start()
         # fleet observability followed the OLD coordinator: if this

@@ -16,6 +16,7 @@ Arm faults with ``MXTPU_FAULT=site:kind[:prob[:seed[:first-last]]]``
 from __future__ import annotations
 
 from . import faults
+from .autoscaler import Autoscaler, CapacityProvider
 from .elastic import (ElasticController, PeerLossError, Preempted,
                       stall_verdict)
 from .faults import InjectedFault
@@ -25,7 +26,8 @@ from .watchdog import StepWatchdog, format_all_stacks
 
 __all__ = ['faults', 'InjectedFault', 'NonFiniteGuard', 'retry_call',
            'StepWatchdog', 'format_all_stacks', 'ElasticController',
-           'PeerLossError', 'Preempted', 'stall_verdict']
+           'PeerLossError', 'Preempted', 'stall_verdict',
+           'Autoscaler', 'CapacityProvider']
 
 # arm any sites named by the environment at import (the config var is
 # read through the declared registry; an empty/unset var arms nothing)

@@ -1,0 +1,273 @@
+"""Fleet-monitor-driven autoscaler policy loop (elastic scale-up PR).
+
+The mechanism half of elasticity lives elsewhere: the membership side
+channel detects loss and admits JOINs (``parallel.dist``), and the
+``ElasticController`` re-forms the mesh in either direction
+(``resilience.elastic``). This module is the POLICY half: a small,
+deterministic decision loop that consumes the ``FleetMonitor``
+detectors (chronic straggler, step-time regression, memory imbalance —
+ISSUE 12/13) plus membership events (lost peers, pending joins, world
+size vs target) and emits three decision kinds through a pluggable
+capacity-provider interface:
+
+- ``evict``            — a rank flagged by a detector for ``strikes``
+  consecutive observes is asked to leave (the provider decides how:
+  SIGTERM for a graceful ``leave()``, a scheduler API call, ...);
+- ``request_capacity`` — the world sits below target (a peer was lost,
+  or an evict opened a hole): ask the provider for replacement ranks;
+- ``admit``            — a JOIN candidate is pending on the side
+  channel: advisory (the ``ElasticController`` performs the actual
+  admission at the next step boundary), recorded so the ledger shows
+  the full loss → request → join → admit causal chain.
+
+Hysteresis keeps the loop stable: detector flags must persist for
+``strikes`` consecutive observes before an evict, every decision kind
+honors a per-target cooldown (``MXTPU_AUTOSCALE_COOLDOWN_SECONDS``),
+and a capacity request stays pending (suppressing re-requests) until a
+join shows up or the cooldown expires. Every decision lands in the
+in-process ledger (``decisions``), the flight recorder
+(``autoscaler.decision`` notes) and the telemetry contract
+(``mxnet_tpu_elastic_autoscaler_decisions_total`` by kind) — a
+post-mortem can replay exactly why the fleet grew or shrank.
+
+The loop is synchronous (call ``observe()`` from the training loop or
+any poll thread): deterministic under test, and the drill's subprocess
+spawner is the reference ``CapacityProvider``.
+"""
+from __future__ import annotations
+
+import logging
+import time as _time
+
+from ..base import telem_flags as _telem
+
+__all__ = ['CapacityProvider', 'Autoscaler']
+
+_log = logging.getLogger('mxnet_tpu.resilience')
+
+# detector flag -> decision kind it escalates to after `strikes`
+# consecutive flagged observes
+_EVICT_FLAGS = ('fleet.straggler', 'fleet.memory_imbalance')
+_REQUEST_FLAGS = ('fleet.step_regression',)
+
+
+class CapacityProvider:
+    """The pluggable seam between autoscaler policy and whatever can
+    actually grant or revoke ranks (a subprocess spawner in the drill,
+    a TPU pod scheduler in production). Implementations must not
+    block: decisions are emitted from the observe loop."""
+
+    def request_capacity(self, count, reason):
+        """Ask for ``count`` new ranks. Fire-and-forget: granted
+        capacity shows up later as JOIN announcements."""
+        raise NotImplementedError
+
+    def evict(self, rank, reason):
+        """Ask ``rank`` to leave (gracefully when possible — a SIGTERM
+        runs its preemption commit)."""
+        raise NotImplementedError
+
+
+class Autoscaler:
+    """Deterministic scale policy over fleet detectors + membership.
+
+    Parameters
+    ----------
+    membership : parallel.dist.Membership, optional
+        Defaults to the process-global one, resolved lazily.
+    monitor : telemetry.fleet.FleetMonitor, optional
+        Defaults to the process-global one (coordinator-side).
+    provider : CapacityProvider, optional
+        Where evict/request decisions are executed. Without one the
+        loop still decides and ledgers (dry-run policy audit).
+    target_world : int, optional
+        The world size the loop defends. Defaults to the membership
+        world at first observe (the nominal fleet).
+    cooldown_seconds / strikes / max_world / min_world
+        Hysteresis knobs; default from MXTPU_AUTOSCALE_* config.
+    """
+
+    def __init__(self, membership=None, monitor=None, provider=None,
+                 target_world=None, cooldown_seconds=None, strikes=None,
+                 max_world=None, min_world=1):
+        from .. import config as _config
+        self._membership = membership
+        self._monitor = monitor
+        self.provider = provider
+        self.target_world = int(target_world) if target_world else None
+        self.cooldown_seconds = float(
+            cooldown_seconds if cooldown_seconds is not None
+            else _config.get('MXTPU_AUTOSCALE_COOLDOWN_SECONDS'))
+        self.strikes = int(strikes if strikes is not None
+                           else _config.get('MXTPU_AUTOSCALE_STRIKES'))
+        self.max_world = int(max_world if max_world is not None
+                             else _config.get('MXTPU_AUTOSCALE_MAX_WORLD'))
+        self.min_world = int(min_world)
+        self.decisions = []          # the in-process decision ledger
+        self._strikes = {}           # (flag, rank) -> consecutive count
+        self._cooldown = {}          # decision key -> monotonic stamp
+        self._evicting = set()       # ranks asked to leave, still alive
+        self._pending_request = 0    # ranks requested, not yet joined
+
+    # -- wiring ------------------------------------------------------------
+
+    @property
+    def membership(self):
+        if self._membership is None:
+            from ..parallel import dist as _dist
+            self._membership = _dist.membership()
+        return self._membership
+
+    @property
+    def monitor(self):
+        if self._monitor is None:
+            from ..telemetry import fleet as _fleet
+            self._monitor = _fleet.monitor()
+        return self._monitor
+
+    # -- the policy loop ---------------------------------------------------
+
+    def observe(self):
+        """One poll: read the membership view + detector flags, update
+        strike counts, emit any due decisions through the provider and
+        the ledger. Returns the decisions made this observe."""
+        ms = self.membership
+        if ms is None:
+            return []
+        try:
+            view = ms.view() or {}
+        except Exception:
+            return []
+        alive = [int(r) for r in view.get('alive', [])]
+        joining = {int(r): float(a)
+                   for r, a in view.get('joining', {}).items()}
+        world = len(alive)
+        if self.target_world is None and world:
+            self.target_world = world
+        self._evicting &= set(alive)   # departed evictees are done
+        out = []
+        out += self._observe_detectors(alive, world)
+        out += self._observe_membership(alive, joining, world)
+        for d in out:
+            self._ledger(d)
+        return out
+
+    def _observe_detectors(self, alive, world):
+        mon = self.monitor
+        if mon is None:
+            return []
+        try:
+            ranks = mon.view()['ranks']
+        except Exception:
+            return []
+        out = []
+        flagged_now = set()
+        for r, st in ranks.items():
+            r = int(r)
+            for flag in set(st.get('flags') or ()):
+                key = (flag, r)
+                flagged_now.add(key)
+                self._strikes[key] = self._strikes.get(key, 0) + 1
+                if self._strikes[key] < self.strikes:
+                    continue
+                if flag in _EVICT_FLAGS:
+                    d = self._decide_evict(r, flag, alive, world)
+                elif flag in _REQUEST_FLAGS:
+                    d = self._decide_request(
+                        1, f'{flag} persisted {self._strikes[key]} '
+                        f'observes', world)
+                else:
+                    d = None
+                if d is not None:
+                    out.append(d)
+        # a flag that cleared resets its strike count — hysteresis is
+        # CONSECUTIVE flagged observes, not lifetime totals
+        for key in list(self._strikes):
+            if key not in flagged_now:
+                del self._strikes[key]
+        return out
+
+    def _observe_membership(self, alive, joining, world):
+        out = []
+        for r, age in sorted(joining.items()):
+            # advisory: the ElasticController admits at the next step
+            # boundary; the ledger records the join being honored (and
+            # the pending capacity request it satisfies)
+            if not self._cooled(('admit', r)):
+                continue
+            self._pending_request = max(0, self._pending_request - 1)
+            out.append({'kind': 'admit', 'rank': r, 'world': world,
+                        'reason': f'join candidate pending '
+                                  f'{round(age, 1)}s'})
+        target = self.target_world or 0
+        if self.max_world:
+            target = min(target, self.max_world)
+        missing = target - world - len(joining) - self._pending_request
+        if missing > 0:
+            d = self._decide_request(
+                missing, f'world {world} below target {target}', world)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def _decide_evict(self, rank, flag, alive, world):
+        if rank not in alive or rank in self._evicting:
+            return None
+        if world - len(self._evicting) <= self.min_world:
+            return None                 # never evict below the floor
+        if not self._cooled(('evict', rank)):
+            return None
+        reason = f'{flag} flagged {self._strikes[(flag, rank)]} ' \
+                 f'consecutive observes'
+        self._evicting.add(rank)
+        if self.provider is not None:
+            try:
+                self.provider.evict(rank, reason)
+            except Exception:
+                _log.exception("autoscaler: provider.evict(%s) failed",
+                               rank)
+        return {'kind': 'evict', 'rank': rank, 'world': world,
+                'reason': reason}
+
+    def _decide_request(self, count, reason, world):
+        if self.max_world and world + self._pending_request >= \
+                self.max_world:
+            return None
+        if not self._cooled(('request_capacity',)):
+            return None
+        count = max(1, int(count))
+        if self.max_world:
+            count = min(count, self.max_world - world)
+        self._pending_request += count
+        if self.provider is not None:
+            try:
+                self.provider.request_capacity(count, reason)
+            except Exception:
+                _log.exception(
+                    "autoscaler: provider.request_capacity(%d) failed",
+                    count)
+        return {'kind': 'request_capacity', 'count': count,
+                'world': world, 'reason': reason}
+
+    def _cooled(self, key):
+        now = _time.monotonic()
+        last = self._cooldown.get(key)
+        if last is not None and now - last < self.cooldown_seconds:
+            return False
+        self._cooldown[key] = now
+        return True
+
+    def _ledger(self, decision):
+        d = dict(decision)
+        d['time'] = _time.time()
+        self.decisions.append(d)
+        _log.warning("autoscaler: %s (%s)", d['kind'], d['reason'])
+        try:
+            from ..telemetry import flight as _flight
+            _flight.note('autoscaler.decision', **d)
+        except Exception:
+            pass
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_elastic_autoscaler_decisions_total',
+                           kind=d['kind'])

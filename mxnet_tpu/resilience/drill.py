@@ -37,8 +37,8 @@ import subprocess
 import sys
 import time as _time
 
-__all__ = ['run_drill', 'run_fleet_drill', 'run_oom_drill',
-           'run_serving_drill']
+__all__ = ['run_drill', 'run_churn_drill', 'run_fleet_drill',
+           'run_oom_drill', 'run_serving_drill']
 
 
 def _free_port():
@@ -76,10 +76,14 @@ def _data_for(step, batch=16, dim=8):
     return x, y
 
 
-def _build(workdir, rank, mesh, autosave_steps=None, replication=False):
+def _build(workdir, rank, mesh, autosave_steps=None, replication=False,
+           ckpt_dir=None):
     """Model + compiled step + checkpoint manager for one worker.
     Explicit prefixes: every process (workers, the reference run) must
-    produce identical parameter names for the states payload to apply."""
+    produce identical parameter names for the states payload to apply.
+    ``ckpt_dir`` overrides the per-rank default — the churn drill runs
+    every incarnation against ONE shared directory (single-writer: only
+    rank 0 commits)."""
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu import checkpoint as _checkpoint
@@ -95,7 +99,7 @@ def _build(workdir, rank, mesh, autosave_steps=None, replication=False):
     step = ShardedTrainStep(net, loss_fn, 'adam',
                             {'learning_rate': 0.05}, mesh=mesh)
     mgr = _checkpoint.CheckpointManager(
-        os.path.join(workdir, f'ckpt-rank{rank}'),
+        ckpt_dir or os.path.join(workdir, f'ckpt-rank{rank}'),
         params=net, trainer=step, async_save=False,
         autosave_steps=autosave_steps,
         replication=None if replication else False)
@@ -821,6 +825,501 @@ def run_drill(workdir, steps=14, kill_at=3, heartbeat=0.2, deadline=1.2,
     }
 
 
+# ---------------------------------------------------------------------------
+# churn-storm drill (elastic scale-UP): randomized kill/join cycles
+
+_CHURN_SAMPLES = 64      # dataset size behind the ElasticShard
+_CHURN_BATCH = 8         # GLOBAL batch — fixed across every world size
+_CHURN_SEED = 11         # shard shuffle seed (shared by every process)
+
+
+class _FileCapacityProvider:
+    """The drill's ``CapacityProvider``: decisions land in a JSONL
+    ledger the parent process — the drill's 'scheduler' — tails.
+    Granted capacity arrives later as a fresh worker process announcing
+    JOIN on the side channel, which closes the autoscaler's
+    loss -> request -> join -> admit loop with real processes."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def request_capacity(self, count, reason):
+        self._append({'count': int(count), 'reason': reason})
+
+    def evict(self, rank, reason):
+        self._append({'evict': int(rank), 'reason': reason})
+
+    def _append(self, doc):
+        doc['wall'] = _time.time()
+        with open(self.path, 'a') as f:
+            f.write(json.dumps(doc) + '\n')
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def _churn_sync(ms, ctl, target):
+    """Emulate the collective's step barrier on the side channel: block
+    until every OTHER alive rank reports ``target`` done (beats
+    piggyback the step counter). Returns False — caller re-enters
+    ``pre_step`` — the moment a peer is lost or a JOIN lands, exactly
+    when a real collective would abort. Without this lockstep an
+    unsynchronized survivor could commit a world-2 step whose partner
+    half was never consumed: a silently dropped sample."""
+    while True:
+        if ms.lost_peers() or ctl._pending_joins(ms):
+            return False
+        view = ms.view() or {}
+        steps = {int(r): int(s)
+                 for r, s in (view.get('steps') or {}).items()}
+        peers = [int(r) for r in view.get('alive', ())
+                 if int(r) != ms.rank]
+        if all(steps.get(r, 0) >= target for r in peers):
+            return True
+        _time.sleep(0.02)
+
+
+def _churn_worker(args):
+    """One churn-drill rank (founding member or JOIN incarnation).
+
+    The data-plane discipline that makes exactly-once provable from the
+    on-disk records: each rank appends (step, position, ids) to its
+    sample ledger and fsyncs BEFORE beating the step — so a survivor
+    can only have committed a world-2 step if the partner's consumption
+    record for it is already on disk. A step whose barrier aborts (peer
+    lost / JOIN pending) is rolled back (``last_step`` retreats to the
+    last synced step) and re-run after the re-form; replaying the
+    ledgers is last-record-wins per step."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    from mxnet_tpu.io import ElasticShard
+    from mxnet_tpu.parallel import dist, make_mesh
+    from mxnet_tpu.resilience import Autoscaler, ElasticController
+
+    rank, tag = args.rank, args.tag
+    ms = dist.Membership(rank, 2, port=args.port,
+                         heartbeat_seconds=args.heartbeat,
+                         deadline_seconds=args.deadline)
+    mesh = make_mesh(devices=jax.local_devices())
+    is_owner = rank == 0
+    net, step, mgr = _build(args.workdir, rank, mesh,
+                            autosave_steps=1 if is_owner else None,
+                            ckpt_dir=os.path.join(args.workdir,
+                                                  'ckpt-shared'))
+    ctl = ElasticController(manager=mgr, membership=ms, step=step,
+                            commit_on_reform=is_owner)
+    holder = {'shard': ElasticShard(_CHURN_SAMPLES, _CHURN_BATCH,
+                                    rank=rank, world=2,
+                                    seed=_CHURN_SEED)}
+    scaler = None
+    if is_owner:
+        # the commit manifest carries the data position: any later
+        # incarnation reshards from it at its new (rank, world)
+        mgr.bind_data_state(lambda: holder['shard'].state())
+        scaler = Autoscaler(
+            membership=ms,
+            provider=_FileCapacityProvider(
+                os.path.join(args.workdir, 'capacity-requests.jsonl')),
+            target_world=2, cooldown_seconds=1.0, strikes=3)
+    progress = os.path.join(args.workdir, f'progress-{tag}.txt')
+    release = os.path.join(args.workdir, 'churn-release')
+    samples = open(os.path.join(args.workdir, f'samples-{tag}.jsonl'),
+                   'a')
+    marks = {'tag': tag, 'rank': rank, 'start_wall': _time.time()}
+    reforms, losses = [], {}
+
+    def _reseed():
+        meta = mgr.last_restored_metadata or {}
+        assert meta.get('data'), \
+            f"restored manifest carries no data position: {meta}"
+        holder['shard'] = ElasticShard.from_state(
+            meta['data'], rank=ctl.last_reform['rank'],
+            world=ctl.last_reform['world'])
+
+    def _note_progress(done):
+        with open(progress, 'w') as f:
+            f.write(str(done))
+
+    i = 0
+    if args.join:
+        resumed = ctl.join()
+        marks['admitted_wall'] = _time.time()
+        reforms.append(dict(ctl.last_reform,
+                            wall=marks['admitted_wall']))
+        i = int(resumed or 0)
+        _reseed()
+        _note_progress(i)
+        _atomic_json(os.path.join(args.workdir, f'admitted-{tag}.json'),
+                     {'tag': tag, 'resumed': i,
+                      'admitted_wall': marks['admitted_wall'],
+                      'reform': dict(ctl.last_reform)})
+    ctl.start_monitor()
+    while True:
+        if i >= args.steps:
+            if not is_owner:
+                break
+            # tail guard: the owner keeps its coordinator seat (still
+            # servicing admissions + the autoscaler loop) until the
+            # parent releases it — a joiner spawned for a late kill
+            # must find a live rendezvous even after training is done
+            if os.path.exists(release):
+                break
+        if is_owner:
+            scaler.observe()
+            if ctl._pending_joins(ms):
+                scaler.observe()    # a JOIN landed since the poll
+                                    # above: ledger the admit decision
+                                    # pre_step is about to honor
+        resumed = ctl.pre_step()
+        if resumed is not None:
+            reforms.append(dict(ctl.last_reform, wall=_time.time()))
+            i = int(resumed)
+            _reseed()
+            continue
+        if i >= args.steps:
+            _time.sleep(0.05)
+            continue
+        shard = holder['shard']
+        pos = shard.position
+        ids = [int(x) for x in shard.next_batch()]
+        loss = _run_step(step, i + 1)
+        # the consumption record must hit the disk BEFORE the beat that
+        # publishes the step: a SIGKILL can then never yield a
+        # committed step whose partner block went unrecorded
+        samples.write(json.dumps({'step': i + 1, 'position': int(pos),
+                                  'ids': ids, 'rank': shard.rank,
+                                  'world': shard.world}) + '\n')
+        samples.flush()
+        os.fsync(samples.fileno())
+        ctl.beat(i + 1)
+        if not _churn_sync(ms, ctl, i + 1):
+            # barrier aborted (peer lost / JOIN pending): the step is
+            # NOT committed — retreat to the last synced step so the
+            # re-form's commit + restore replays it
+            ctl.last_step = i
+            continue
+        i += 1
+        losses[i] = float(loss).hex()
+        if is_owner:
+            mgr.maybe_save(i)
+        _note_progress(i)
+        if args.step_sleep:
+            _time.sleep(args.step_sleep)
+    ctl.stop_monitor()
+    samples.close()
+    out = {'marks': marks, 'losses': losses, 'reforms': reforms,
+           'world': ms.world_size(), 'peer_losses': ctl.peer_losses}
+    if is_owner:
+        out['autoscaler'] = scaler.decisions
+    _atomic_json(os.path.join(args.workdir, f'result-{tag}.json'), out)
+    mgr.close()
+    ms.stop()
+
+
+def _churn_baseline(args):
+    """Fixed-world reference: one process, no churn, same model and
+    per-step data — the trajectory every churn survivor must match
+    bit-for-bit."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    from mxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh(devices=jax.local_devices())
+    bdir = os.path.join(args.workdir, 'baseline')
+    os.makedirs(bdir, exist_ok=True)
+    net, step, mgr = _build(bdir, 0, mesh)
+    losses = {}
+    for i in range(args.steps):
+        losses[i + 1] = float(_run_step(step, i + 1)).hex()
+    mgr.close()
+    _atomic_json(os.path.join(args.workdir, 'result-baseline.json'),
+                 {'losses': losses})
+
+
+def run_churn_drill(workdir, steps=30, cycles=3, heartbeat=0.15,
+                    deadline=1.2, step_sleep=0.2, seed=23,
+                    timeout=420.0):
+    """Churn storm (elastic scale-UP acceptance): ``cycles`` randomized
+    SIGKILL + rejoin rounds against a two-rank elastic world, then
+    prove the storm was harmless:
+
+    1. the owner's loss trajectory is bit-identical to a fixed-world
+       run that was never interrupted;
+    2. data exactly-once: replaying every incarnation's consumption
+       ledger (pruned to each cycle's committed rollback point) covers
+       every global batch exactly once — no sample dropped, none seen
+       twice — and every record's block matches the deterministic
+       world-indexed assignment at its recorded position;
+    3. the re-form ledger shows one shrink + one admission per cycle,
+       and the autoscaler requested + admitted capacity each time.
+
+    Kill steps are randomized-but-deterministic via the fault
+    registry's hash stream (``faults._unit(seed, cycle)``). Returns
+    per-cycle MTTR phases (detect / request / rendezvous / admission /
+    full restore-world time) for PERF_NOTES."""
+    from .faults import _unit
+    os.makedirs(workdir, exist_ok=True)
+    side_port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        'PYTHONPATH': os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))] +
+            ([env['PYTHONPATH']] if env.get('PYTHONPATH') else [])),
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+        # process-local meshes by construction: the membership side
+        # channel is the only cross-process link (no jax.distributed)
+        'MXNET_TPU_NUM_PROCS': '1',
+        'MXNET_TPU_PROC_ID': '0',
+        'MXTPU_ELASTIC': '0',
+    })
+    env.pop('MXNET_TPU_COORDINATOR', None)
+    base = [sys.executable, '-m', 'mxnet_tpu.resilience.drill',
+            '--workdir', workdir, '--steps', str(steps),
+            '--port', str(side_port), '--heartbeat', str(heartbeat),
+            '--deadline', str(deadline),
+            '--step-sleep', str(step_sleep)]
+    req_path = os.path.join(workdir, 'capacity-requests.jsonl')
+    procs, logs = {}, []
+
+    def _spawn(tag, rank, join=False):
+        log = open(os.path.join(workdir, f'worker-{tag}.log'), 'wb')
+        logs.append(log)
+        cmd = base + ['--churn-worker', '--rank', str(rank),
+                      '--tag', tag] + (['--join'] if join else [])
+        procs[tag] = subprocess.Popen(cmd, env=env, stdout=log,
+                                      stderr=subprocess.STDOUT)
+
+    def _fail(msg):
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        errs = []
+        for log in logs:
+            log.flush()
+            try:
+                with open(log.name, 'rb') as f:
+                    errs.append(f"-- {os.path.basename(log.name)} --\n"
+                                + f.read().decode(
+                                    errors='replace')[-3000:])
+            except OSError:
+                pass
+        raise AssertionError(msg + '\n' + '\n'.join(errs))
+
+    def _requests():
+        try:
+            with open(req_path) as f:
+                return [json.loads(ln) for ln in f if ln.strip()]
+        except OSError:
+            return []
+
+    # randomized-but-deterministic kill schedule: cycle c kills inside
+    # the c-th slice of the step budget so cycles never collide
+    lo = 3
+    span = max(1, (max(4, steps - 4) - lo) // cycles)
+    kill_steps = [lo + c * span + int(_unit(seed, c) * span)
+                  for c in range(cycles)]
+
+    _spawn('r0', 0)
+    _spawn('r1c0', 1)
+    cycle_stats = []
+    last_resumed = 0
+    try:
+        for c in range(cycles):
+            victim = f'r1c{c}'
+            target = min(steps - 2,
+                         max(kill_steps[c], last_resumed + 2))
+            for tag in ('r0', victim):
+                if not _wait_progress(
+                        os.path.join(workdir, f'progress-{tag}.txt'),
+                        target, timeout / 2):
+                    _fail(f"churn: {tag} never reached step {target} "
+                          f"(cycle {c})")
+            nreq = len(_requests())
+            procs[victim].kill()        # SIGKILL mid-step, no flush
+            kill_wall = _time.time()
+            procs[victim].wait()
+            # the autoscaler inside rank 0 must notice the shrink and
+            # ask this parent — its capacity provider — for a new rank
+            deadline_t = _time.monotonic() + timeout / 4
+            while _time.monotonic() < deadline_t:
+                if len(_requests()) > nreq:
+                    break
+                if procs['r0'].poll() is not None:
+                    _fail(f"churn: rank 0 died during cycle {c}")
+                _time.sleep(0.05)
+            else:
+                _fail(f"churn: autoscaler never requested capacity "
+                      f"after kill {c}")
+            request_wall = float(_requests()[-1]['wall'])
+            joiner = f'r1c{c + 1}'
+            spawn_wall = _time.time()
+            _spawn(joiner, 1, join=True)
+            admit_path = os.path.join(workdir,
+                                      f'admitted-{joiner}.json')
+            while _time.monotonic() < deadline_t:
+                if os.path.exists(admit_path):
+                    break
+                if procs[joiner].poll() is not None:
+                    _fail(f"churn: joiner {joiner} died before "
+                          f"admission")
+                _time.sleep(0.05)
+            else:
+                _fail(f"churn: {joiner} was never admitted")
+            with open(admit_path) as f:
+                admitted = json.load(f)
+            last_resumed = int(admitted['resumed'])
+            cycle_stats.append({
+                'cycle': c, 'kill_step': target,
+                'kill_wall': kill_wall,
+                'request_wall': request_wall,
+                'spawn_wall': spawn_wall,
+                'admitted_wall': float(admitted['admitted_wall']),
+                'resumed': last_resumed,
+            })
+        last_tag = f'r1c{cycles}'
+        try:
+            rc = procs[last_tag].wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            _fail(f"churn: {last_tag} never finished")
+        if rc != 0:
+            _fail(f"churn: {last_tag} exited rc={rc}")
+        # release the owner's tail guard now every joiner is through
+        with open(os.path.join(workdir, 'churn-release'), 'w') as f:
+            f.write('done')
+        try:
+            rc = procs['r0'].wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            _fail("churn: rank 0 never finished")
+        if rc != 0:
+            _fail(f"churn: rank 0 exited rc={rc}")
+        # fixed-world reference trajectory
+        r = subprocess.run(
+            base + ['--churn-baseline'], env=env,
+            capture_output=True, timeout=timeout)
+        if r.returncode != 0:
+            _fail("churn: baseline run failed\n" +
+                  r.stdout.decode(errors='replace')[-3000:] +
+                  r.stderr.decode(errors='replace')[-3000:])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    with open(os.path.join(workdir, 'result-r0.json')) as f:
+        r0 = json.load(f)
+    with open(os.path.join(workdir, 'result-baseline.json')) as f:
+        ref = json.load(f)
+
+    # 1. loss parity: the churned trajectory IS the fixed-world one
+    assert r0['losses'] == ref['losses'], (
+        "churned trajectory diverges from the fixed-world run",
+        {k: (r0['losses'].get(k), ref['losses'].get(k))
+         for k in set(r0['losses']) | set(ref['losses'])
+         if r0['losses'].get(k) != ref['losses'].get(k)})
+
+    # 2. the re-form ledger: one shrink + one admission per cycle
+    shrinks = [rf for rf in r0['reforms'] if rf.get('lost')]
+    grows = [rf for rf in r0['reforms'] if rf.get('grow')]
+    assert len(shrinks) == cycles and len(grows) == cycles, \
+        r0['reforms']
+
+    # 3. exactly-once coverage replayed from the consumption ledgers
+    from ..io.io import ElasticShard
+    exp = ElasticShard(_CHURN_SAMPLES, _CHURN_BATCH, rank=0, world=1,
+                       seed=_CHURN_SEED)
+
+    def _records(tag):
+        out = {}
+        try:
+            with open(os.path.join(workdir,
+                                   f'samples-{tag}.jsonl')) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue   # torn final line of a SIGKILL
+                    out[int(rec['step'])] = rec   # last record wins
+        except OSError:
+            pass
+        return out
+
+    recs, prune = {'r0': _records('r0')}, {}
+    for c in range(cycles + 1):
+        tag = f'r1c{c}'
+        recs[tag] = _records(tag)
+        if c < cycles:
+            # a dead incarnation's records past the shrink re-form's
+            # committed rollback point were never part of the
+            # trajectory: the survivor re-ran those steps itself
+            prune[tag] = int(shrinks[c]['resumed_step'])
+    for s in range(1, steps + 1):
+        base_pos = (s - 1) * _CHURN_BATCH
+        want = [int(exp.sample_at(base_pos + j))
+                for j in range(_CHURN_BATCH)]
+        got = []
+        for tag, rs in sorted(recs.items()):
+            rec = rs.get(s)
+            if rec is None or (tag in prune and s > prune[tag]):
+                continue
+            per = _CHURN_BATCH // int(rec['world'])
+            blk = int(rec['rank']) * per
+            assert rec['ids'] == want[blk:blk + per], (
+                f"step {s}: {tag} consumed the wrong block", rec, want)
+            assert int(rec['position']) == base_pos, (s, rec)
+            got.extend(rec['ids'])
+        assert sorted(got) == sorted(want), (
+            f"step {s}: global batch not covered exactly once",
+            {'missing': sorted(set(want) - set(got)),
+             'extra': sorted({x for x in got if got.count(x) > 1})})
+
+    # 4. the autoscaler drove every recovery
+    ledger = r0.get('autoscaler') or []
+    n_req = sum(1 for d in ledger if d['kind'] == 'request_capacity')
+    n_adm = sum(1 for d in ledger if d['kind'] == 'admit')
+    assert n_req >= cycles and n_adm >= cycles, ledger
+
+    mttr = []
+    for c, st in enumerate(cycle_stats):
+        shrink, grow = shrinks[c], grows[c]
+        mttr.append({
+            'cycle': c, 'kill_step': st['kill_step'],
+            'detect_seconds': round(
+                shrink['wall'] - shrink['reform_seconds']
+                - st['kill_wall'], 3),
+            'shrink_reform_seconds': shrink['reform_seconds'],
+            'request_seconds': round(
+                st['request_wall'] - st['kill_wall'], 3),
+            'spawn_seconds': round(
+                st['spawn_wall'] - st['kill_wall'], 3),
+            'rendezvous_seconds': grow['rendezvous_seconds'],
+            'admission_seconds': grow['admission_seconds'],
+            'restored_world_seconds': round(
+                st['admitted_wall'] - st['kill_wall'], 3),
+        })
+    return {
+        'ok': True, 'steps': steps, 'cycles': cycles,
+        'kill_steps': [st['kill_step'] for st in cycle_stats],
+        'loss_parity': True, 'coverage_exact': True,
+        'autoscaler': {'requests': n_req, 'admits': n_adm,
+                       'decisions': len(ledger)},
+        'mttr': mttr,
+    }
+
+
 def _serve_model():
     """The drill's serving model: tiny token-in/logits-out block. Every
     process builds it identically (auto-named — the jit boundary is
@@ -1145,9 +1644,17 @@ def main(argv=None):
     ap.add_argument('--ref-rank', type=int, default=0)
     ap.add_argument('--disk-loss', action='store_true')
     ap.add_argument('--ckpt-owner', type=int, default=None)
+    ap.add_argument('--churn-worker', action='store_true')
+    ap.add_argument('--churn-baseline', action='store_true')
+    ap.add_argument('--join', action='store_true')
+    ap.add_argument('--tag', default='')
     args = ap.parse_args(argv)
     if args.serve:
         _serving_worker(args)
+    elif args.churn_worker:
+        _churn_worker(args)
+    elif args.churn_baseline:
+        _churn_baseline(args)
     elif args.fleet and args.worker is False and args.reference is False:
         _fleet_worker(args)
     elif args.worker:

@@ -160,6 +160,23 @@ def stall_verdict(membership=None):
     }
     if fetching:
         v['during'] = 'replica_fetch'
+    # scale-up admission upgrade: a "local" stall while a JOIN
+    # candidate is pending is almost always the admission rendezvous in
+    # flight — every survivor quiesces at its next step boundary, so
+    # the last ones to arrive see the early ones "stalled". The verdict
+    # names the joining rank(s) and the rendezvous age instead of
+    # blaming local code. Peer loss still wins: a rank dying DURING an
+    # admission is the more urgent story.
+    try:
+        jm = getattr(membership, 'joining', None)
+        joining = jm() if callable(jm) else {}
+    except Exception:
+        joining = {}
+    if joining:
+        v['joining'] = {int(r): round(float(a), 3)
+                        for r, a in joining.items()}
+        if v['verdict'] == 'local_stall':
+            v['verdict'] = 'reform_pending'
     # fleet straggler upgrade (ISSUE 13): when cross-rank telemetry
     # snapshots are flowing, a "local" stall with a detector-flagged
     # straggler is most likely THIS rank waiting inside a collective on
@@ -408,7 +425,10 @@ class ElasticController:
         - Peer lost: commit, tear down, re-form at the survivor world
           size, restore — returns the RESUMED step number (the loop
           should continue from there).
-        - Otherwise: returns None, costing two lock-free reads.
+        - JOIN candidate pending: commit, quiesce, admission
+          rendezvous, re-form at the LARGER world, restore — returns
+          the resumed step, same contract as the shrink path.
+        - Otherwise: returns None, costing a few lock-free reads.
         """
         if self.preempt_requested:
             self._commit(final=True)
@@ -423,9 +443,20 @@ class ElasticController:
         if ms is None:
             return None
         lost = ms.lost_peers()
-        if not lost:
-            return None
-        return self._reform(lost)
+        if lost:
+            return self._reform(lost)
+        joining = self._pending_joins(ms)
+        if joining:
+            return self._admit(joining)
+        return None
+
+    @staticmethod
+    def _pending_joins(ms):
+        jm = getattr(ms, 'joining', None)
+        try:
+            return jm() if callable(jm) else {}
+        except Exception:
+            return {}
 
     # -- the re-form path --------------------------------------------------
 
@@ -562,6 +593,138 @@ class ElasticController:
             self.last_reform['teardown_seconds'],
             self.last_reform['restore_seconds'], resumed)
         _flight.note('elastic.reform', **self.last_reform)
+        return resumed
+
+    # -- the scale-up admission path ---------------------------------------
+
+    def join(self, timeout=None):
+        """Joiner-side admission (scale-UP): announce this rank on the
+        membership side channel, rendezvous with the survivors when
+        they quiesce at their next step boundary, re-form the mesh at
+        the LARGER world and restore the committed checkpoint — the
+        attach-anytime property the reference's kvstore fleet had.
+        Bounded by ``MXTPU_JOIN_TIMEOUT_SECONDS``. Returns the resumed
+        step (None when nothing was committed yet)."""
+        return self._admit({}, joiner=True, timeout=timeout)
+
+    def _admit(self, joining, joiner=False, timeout=None):
+        from .. import config as _config
+        from ..telemetry import flight as _flight, trace as _trace
+        from ..parallel import dist as _dist
+        from ..parallel.mesh import make_mesh, set_default_mesh
+        from . import faults as _faults
+        import jax
+
+        ms = self.membership
+        _faults.fire('elastic.admit')
+        timeout = float(timeout) if timeout is not None else \
+            float(_config.get('MXTPU_JOIN_TIMEOUT_SECONDS'))
+        if not joiner:
+            _log.warning(
+                "elastic: JOIN candidate(s) %s pending (announced %ss "
+                "ago) — committing, quiescing at this step boundary "
+                "and re-forming at the larger world", sorted(joining),
+                {r: round(a, 1) for r, a in joining.items()})
+        t0 = _time.perf_counter()
+        with _trace.span('elastic.admit', joining=len(joining)):
+            # 1. survivors commit: the admission's restart point. The
+            # payloads are host-gathered fp32, so the joiner re-places
+            # state committed by a world it was never part of. The
+            # joiner itself has nothing to commit (and no live
+            # jax.distributed world to tear down).
+            committed = None
+            if not joiner:
+                committed = self._commit() if self.commit_on_reform \
+                    else (self.manager.latest_step()
+                          if self.manager is not None else None)
+                _dist.shutdown()
+            t_commit = _time.perf_counter()
+            # 2. the generation-counted admission rendezvous: it
+            # completes only when every ALIVE rank and every PENDING
+            # joiner has arrived, and completion atomically promotes
+            # the joiners into the alive set — the completed reply's
+            # view is already the larger world, identical on every
+            # rank. A joiner whose announcement was cancelled by a
+            # concurrent loss re-form (removed ranks drop pending
+            # joins) re-announces and waits for the next boundary.
+            deadline = _time.monotonic() + timeout
+            while True:
+                if joiner:
+                    ms.join()
+                view = ms.barrier(
+                    _dist.ADMIT_TAG,
+                    timeout=max(1.0, deadline - _time.monotonic()))
+                alive = sorted(int(r) for r in view.get('alive', []))
+                if ms.rank in alive:
+                    break
+                if not joiner or _time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"elastic admission failed: rank {ms.rank} not "
+                        f"in the post-rendezvous alive set {alive} "
+                        f"(announcement cancelled by a concurrent "
+                        f"re-form?)")
+            new_world = len(alive)
+            new_rank = alive.index(ms.rank)
+            if new_world > 1:
+                if self.reinit_fn is not None:
+                    self.reinit_fn(new_world, new_rank)
+                else:
+                    _log.warning(
+                        "elastic: %d ranks after admission but no "
+                        "reinit_fn — keeping process-local meshes "
+                        "(cross-process collectives need a new "
+                        "jax.distributed coordinator; pass reinit_fn "
+                        "to re-span)", new_world)
+            if self.mesh_fn is not None:
+                mesh = self.mesh_fn(new_world, new_rank)
+            else:
+                mesh = make_mesh(devices=jax.local_devices())
+            set_default_mesh(mesh)
+            t_rendezvous = _time.perf_counter()
+            # 3. re-place + restore at the larger world: survivors drop
+            # compiled programs/shardings and re-derive their ZeRO
+            # stage for the new dp degree (reset_mesh handles growth
+            # the same way it handles shrink), the joiner compiles
+            # fresh; then the committed layout-independent checkpoint
+            # restores params + optimizer state + RNG on every rank.
+            for st in self._steps:
+                st.reset_mesh(mesh)
+            for tr in self._trainers:
+                tr._on_reform(mesh)
+            resumed = self.manager.restore_latest() \
+                if self.manager is not None else committed
+            for fn in self._on_reform_hooks:
+                fn(mesh)
+        dt = _time.perf_counter() - t0
+        self.reforms += 1
+        self.last_reform = {
+            'joined': [ms.rank] if joiner
+                      else sorted(int(r) for r in joining),
+            'world': new_world,
+            'rank': new_rank,
+            'resumed_step': resumed,
+            'grow': True,
+            'commit_seconds': round(t_commit - t0, 4),
+            'rendezvous_seconds': round(t_rendezvous - t_commit, 4),
+            'restore_seconds': round(dt - (t_rendezvous - t0), 4),
+            'admission_seconds': round(dt, 4),
+            'reform_seconds': round(dt, 4),
+        }
+        if _telem['on']:
+            from .. import telemetry as _telemetry
+            _telemetry.inc('mxnet_tpu_elastic_reforms_total')
+            _telemetry.set_gauge('mxnet_tpu_elastic_last_world_size',
+                                 new_world)
+            _telemetry.observe('mxnet_tpu_elastic_admission_seconds', dt)
+        _log.warning(
+            "elastic: admitted rank(s) %s — re-formed at world size %d "
+            "(rank %d) in %.3fs (commit %.3fs, rendezvous %.3fs, "
+            "restore %.3fs) — resuming from committed step %s",
+            self.last_reform['joined'], new_world, new_rank, dt,
+            self.last_reform['commit_seconds'],
+            self.last_reform['rendezvous_seconds'],
+            self.last_reform['restore_seconds'], resumed)
+        _flight.note('elastic.admit', **self.last_reform)
         return resumed
 
     # -- lifecycle ---------------------------------------------------------

@@ -106,6 +106,16 @@ _SITES = {
     'dist.barrier': ('membership barrier entry (dist.barrier / kvstore '
                      'barrier on dist stores) — the rendezvous every '
                      'mesh re-form crosses', ('raise', 'hang')),
+    'dist.join': ('elastic membership JOIN announcement (parallel.dist.'
+                  'Membership.join; raise fails the announcement so the '
+                  'joiner retries or aborts; hang delays it so the '
+                  'admission rendezvous ages — the REFORM PENDING '
+                  'verdict drills against this)', ('raise', 'hang')),
+    'elastic.admit': ('scale-up admission re-form entry (Elastic'
+                      'Controller._admit, survivors and joiner alike) — '
+                      'raise aborts the admission before teardown; hang '
+                      'stalls the rendezvous into the watchdog window',
+                      ('raise', 'hang')),
     'alloc.oom': ('device allocator exhaustion: a raise here surfaces '
                   'as a synthetic RESOURCE_EXHAUSTED through the '
                   'telemetry.memory.oom_guard wrapping step dispatch, '

@@ -168,6 +168,8 @@ class StepWatchdog:
                     note['straggler'] = verdict['straggler']
                 if verdict.get('compiling'):
                     note['compiling'] = verdict['compiling']
+                if verdict.get('joining'):
+                    note['joining'] = verdict['joining']
             _flight.note('watchdog.stall', **note)
             path = _flight.dump(reason='watchdog_stall')
             if path:
@@ -257,6 +259,18 @@ class StepWatchdog:
                     f"expect it to clear, or persist the cache "
                     f"(MXTPU_COMPILE_CACHE_DIR) so the next cold start "
                     f"skips it."))
+            elif verdict.get('verdict') == 'reform_pending':
+                j = verdict.get('joining') or {}
+                names = ', '.join(
+                    f"rank {r} (announced {a:.1f}s ago)"
+                    for r, a in sorted(j.items()))
+                lines.insert(1, (
+                    f"verdict: REFORM PENDING — a scale-up admission "
+                    f"rendezvous is in flight: joining {names or j}; "
+                    f"every survivor quiesces at its next step boundary "
+                    f"and re-forms at the larger world, so the stall is "
+                    f"the rendezvous, not a wedge. Bounded by "
+                    f"MXTPU_JOIN_TIMEOUT_SECONDS."))
             elif verdict.get('verdict') == 'straggler_suspected':
                 s = verdict['straggler']
                 lines.insert(1, (

@@ -51,10 +51,14 @@ class StubMembership:
     heartbeat_seconds = 0.05
     current_step = None
 
-    def __init__(self, lost=(), ages=None):
+    def __init__(self, lost=(), ages=None, joining=None):
         self._lost = list(lost)
         self._ages = dict(ages or {})
+        self._joining = dict(joining or {})
         self.left = False
+
+    def joining(self):
+        return dict(self._joining)
 
     def lost_peers(self):
         return list(self._lost)
@@ -545,6 +549,262 @@ def test_trainer_attach_elastic_preemption(tmp_path):
 # ---------------------------------------------------------------------------
 # the e2e drill (satellite: multi-process elastic drill in CI)
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# scale-UP: JOIN announcement, admission rendezvous, autoscaler policy
+# ---------------------------------------------------------------------------
+
+def test_membership_join_admission_rendezvous():
+    """A replacement rank announces JOIN: pending (aging) in every
+    view, beats while departed do NOT resurrect it, and completing the
+    admission rendezvous atomically promotes it into the alive set."""
+    import threading
+    m0, m1 = _pair(_free_port())
+    try:
+        assert _wait_until(lambda: (m0.view() or {}).get('world') == 2)
+        m1.stop()                      # SIGKILL analog
+        assert _wait_until(lambda: m0.lost_peers() == [1], timeout=3.0)
+        m0.remove_peers([1])           # the shrink re-form's bookkeeping
+        assert m0.world_size() == 1
+        m2 = dist.Membership(1, 2, port=m0.port, heartbeat_seconds=0.05,
+                             deadline_seconds=0.5)
+        try:
+            # beating while in `left` must not resurrect the rank
+            time.sleep(0.2)
+            assert m0.alive() == [0]
+            m2.join()
+            assert _wait_until(lambda: 1 in m0.joining(), timeout=2.0)
+            assert m0.alive() == [0]   # announced != admitted
+            out = []
+            t = threading.Thread(target=lambda: out.append(
+                m2.barrier(dist.ADMIT_TAG, timeout=5.0)))
+            t.start()
+            view = m0.barrier(dist.ADMIT_TAG, timeout=5.0)
+            t.join(5.0)
+            assert view['alive'] == [0, 1]
+            assert out and out[0]['alive'] == [0, 1]
+            assert m0.joining() == {}  # promoted, no longer pending
+            assert m0.world_size() == 2 and m0.lost_peers() == []
+        finally:
+            m2.stop()
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_join_and_admit_fault_sites_registered():
+    """Satellite: the new fault sites exist and fire deterministically
+    so drills can kill a rank exactly at the JOIN announcement or the
+    admission boundary."""
+    assert 'dist.join' in faults.sites()
+    assert 'elastic.admit' in faults.sites()
+    m = dist.Membership(0, 1, port=_free_port(), heartbeat_seconds=0.05,
+                        deadline_seconds=0.5)
+    try:
+        faults.arm('dist.join', 'raise')
+        with pytest.raises(faults.InjectedFault):
+            m.join()
+    finally:
+        faults.disarm()
+        m.stop()
+
+
+def test_controller_admission_grows_world(tmp_path):
+    """Survivor + joiner complete the admission in-process: pre_step
+    returns the committed step on the survivor, join() the same step on
+    the joiner, both re-form at the larger world, and the joiner's
+    restored trajectory equals the survivor's."""
+    import threading
+    x, y = _batch()
+    m0 = dist.Membership(0, 1, port=_free_port(), heartbeat_seconds=0.05,
+                         deadline_seconds=0.5)
+    mj = dist.Membership(1, 2, port=m0.port, heartbeat_seconds=0.05,
+                         deadline_seconds=0.5)
+    try:
+        net, step = _tiny('adm', make_mesh((2,), ('dp',)))
+        mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                           trainer=step, async_save=False)
+        ctl = ElasticController(manager=mgr, membership=m0, step=step)
+        for i in range(2):
+            step(x, y)
+            ctl.beat(i + 1)
+        net2, step2 = _tiny('adm', make_mesh((2,), ('dp',)))
+        mgr2 = checkpoint.CheckpointManager(str(tmp_path), params=net2,
+                                            trainer=step2,
+                                            async_save=False)
+        ctl2 = ElasticController(manager=mgr2, membership=mj, step=step2,
+                                 commit_on_reform=False)
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(resumed=ctl2.join(timeout=10.0)))
+        t.start()
+        assert _wait_until(lambda: ctl._pending_joins(m0), timeout=3.0)
+        resumed = ctl.pre_step()       # quiesce + admit at the boundary
+        t.join(10.0)
+        assert resumed == 2 and out.get('resumed') == 2
+        assert ctl.last_reform['grow'] and ctl.last_reform['world'] == 2
+        assert ctl.last_reform['rank'] == 0
+        assert ctl.last_reform['joined'] == [1]
+        assert ctl.last_reform['admission_seconds'] > 0
+        assert ctl2.last_reform['world'] == 2
+        assert ctl2.last_reform['rank'] == 1
+        assert m0.world_size() == 2
+        a = [float(step(x, y).asnumpy()) for _ in range(2)]
+        b = [float(step2(x, y).asnumpy()) for _ in range(2)]
+        assert a == b                  # identical restored state
+    finally:
+        m0.stop()
+        mj.stop()
+
+
+def test_stall_verdict_reform_pending_names_joiner():
+    """Satellite: a 'local' stall while a JOIN candidate is pending is
+    the admission rendezvous in flight — the verdict says so and names
+    the joining rank + its announcement age."""
+    v = stall_verdict(StubMembership(joining={3: 2.5}))
+    assert v['verdict'] == 'reform_pending'
+    assert v['joining'] == {3: 2.5}
+    # peer loss still wins: a rank dying DURING an admission is the
+    # more urgent story
+    v = stall_verdict(StubMembership(lost=[1], ages={1: 9.0},
+                                     joining={3: 2.5}))
+    assert v['verdict'] == 'peer_loss_suspected'
+    assert v['joining'] == {3: 2.5}
+
+
+def test_watchdog_reform_pending_report():
+    reports = []
+    wd = resilience.StepWatchdog(
+        deadline_seconds=0.2, poll_seconds=0.05,
+        on_stall=reports.append,
+        membership=StubMembership(joining={2: 1.25}))
+    with wd:
+        assert _wait_until(lambda: reports, timeout=3.0)
+    assert 'REFORM PENDING' in reports[0]
+    assert 'rank 2' in reports[0] and '1.2' in reports[0]
+    assert 'MXTPU_JOIN_TIMEOUT_SECONDS' in reports[0]
+
+
+class _Provider:
+    def __init__(self):
+        self.requests, self.evictions = [], []
+
+    def request_capacity(self, count, reason):
+        self.requests.append((count, reason))
+
+    def evict(self, rank, reason):
+        self.evictions.append((rank, reason))
+
+
+class _ScriptedMonitor:
+    def __init__(self):
+        self.flags = {}
+
+    def view(self):
+        return {'ranks': {r: {'flags': list(f)}
+                          for r, f in self.flags.items()}}
+
+
+class _ScriptedMembership(StubMembership):
+    def __init__(self, alive=(0,), joining=None):
+        super().__init__()
+        self._alive = list(alive)
+        self._join = dict(joining or {})
+
+    def view(self):
+        v = {'alive': list(self._alive), 'world': len(self._alive)}
+        if self._join:
+            v['joining'] = {str(r): a for r, a in self._join.items()}
+        return v
+
+
+def test_autoscaler_requests_capacity_below_target():
+    from mxnet_tpu.resilience import Autoscaler
+    ms = _ScriptedMembership(alive=(0,))
+    pr = _Provider()
+    sc = Autoscaler(membership=ms, monitor=_ScriptedMonitor(),
+                    provider=pr, target_world=2,
+                    cooldown_seconds=30.0, strikes=2)
+    out = sc.observe()
+    assert [d['kind'] for d in out] == ['request_capacity']
+    assert pr.requests == [(1, 'world 1 below target 2')]
+    # the pending request suppresses re-requests (hysteresis)...
+    assert sc.observe() == []
+    # ...until the join shows up: advisory admit, pending retired
+    ms._join = {1: 0.4}
+    out = sc.observe()
+    assert [d['kind'] for d in out] == ['admit']
+    assert out[0]['rank'] == 1
+    ms._join = {}
+    ms._alive = [0, 1]
+    assert sc.observe() == []          # fleet whole again
+    # the full causal chain sits in the ledger, in order
+    assert [d['kind'] for d in sc.decisions] == ['request_capacity',
+                                                 'admit']
+    assert all('time' in d and 'reason' in d for d in sc.decisions)
+
+
+def test_autoscaler_evicts_after_strikes_with_floor():
+    from mxnet_tpu.resilience import Autoscaler
+    ms = _ScriptedMembership(alive=(0, 1, 2))
+    mon = _ScriptedMonitor()
+    pr = _Provider()
+    sc = Autoscaler(membership=ms, monitor=mon, provider=pr,
+                    target_world=3, cooldown_seconds=30.0, strikes=3,
+                    min_world=2)
+    mon.flags = {1: ('fleet.straggler',)}
+    assert sc.observe() == [] and sc.observe() == []   # 2 strikes: hold
+    out = sc.observe()                                 # 3rd: evict
+    assert [d['kind'] for d in out] == ['evict'] and out[0]['rank'] == 1
+    assert pr.evictions[0][0] == 1
+    assert 'fleet.straggler' in pr.evictions[0][1]
+    # hysteresis is CONSECUTIVE observes: a cleared flag resets
+    mon.flags = {2: ('fleet.memory_imbalance',)}
+    assert sc.observe() == []                          # strike 1
+    mon.flags = {}
+    assert sc.observe() == []                          # reset
+    mon.flags = {2: ('fleet.memory_imbalance',)}
+    assert sc.observe() == [] and sc.observe() == []   # 1, 2 again
+    # 3rd strike due — but rank 1 is already evicting and min_world=2
+    # floors the fleet: no second eviction
+    assert sc.observe() == []
+
+
+def test_autoscaler_step_regression_requests_with_max_world():
+    from mxnet_tpu.resilience import Autoscaler
+    ms = _ScriptedMembership(alive=(0, 1))
+    mon = _ScriptedMonitor()
+    pr = _Provider()
+    sc = Autoscaler(membership=ms, monitor=mon, provider=pr,
+                    target_world=2, cooldown_seconds=30.0, strikes=2,
+                    max_world=3)
+    mon.flags = {0: ('fleet.step_regression',)}
+    assert sc.observe() == []
+    out = sc.observe()
+    assert [d['kind'] for d in out] == ['request_capacity']
+    assert 'step_regression' in out[0]['reason']
+    # max_world clamps: world 2 + 1 pending request is the ceiling
+    assert sc.observe() == []
+
+
+@pytest.mark.slow  # duplicated by the dryrun_multichip scale-up stage
+def test_churn_storm_drill(tmp_path):
+    """The full acceptance drill: >= 3 randomized SIGKILL + rejoin
+    cycles; trajectory sample-for-sample and loss-identical to a
+    fixed-world run, exactly-once coverage replayed from the
+    manifest-recorded positions, autoscaler-driven recovery, per-cycle
+    MTTR measured."""
+    from mxnet_tpu.resilience.drill import run_churn_drill
+    res = run_churn_drill(str(tmp_path))
+    assert res['ok'] and res['loss_parity'] and res['coverage_exact']
+    assert res['cycles'] >= 3
+    assert res['autoscaler']['requests'] >= res['cycles']
+    assert res['autoscaler']['admits'] >= res['cycles']
+    assert len(res['mttr']) == res['cycles']
+    for m in res['mttr']:
+        assert 0 < m['detect_seconds'] < 10
+        assert 0 < m['restored_world_seconds'] < 60
+
 
 @pytest.mark.slow  # duplicated by the dryrun_multichip elastic stage
 def test_elastic_drill_kill_one_of_two_workers(tmp_path):

@@ -1,0 +1,171 @@
+"""Deterministic data resharding across elastic world changes (the
+scale-UP PR's data plane): the ElasticShard exactly-once guarantee
+across any shrink->grow chain, the checkpoint-manifest round-trip of
+the data position, and the iterator/sampler wiring on top."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.data import DataLoader, ElasticSampler
+from mxnet_tpu.io import ElasticShard, NDArrayIter
+
+G, N = 8, 32     # global batch / dataset size (4 batches per epoch)
+
+
+def _reference_batches(steps, seed=5):
+    """Fixed-world (world=1) sample order: the ground truth every
+    elastic history must re-partition without loss or duplication."""
+    ref = ElasticShard(N, G, rank=0, world=1, seed=seed)
+    return [[ref.sample_at(s * G + j) for j in range(G)]
+            for s in range(steps)]
+
+
+def test_elastic_shard_exactly_once_across_shrink_grow():
+    """dp=4 -> 2 -> 4 mid-epoch: concatenating every rank's block per
+    step reproduces the fixed-world batches sample-for-sample — no
+    sample dropped, none double-seen, across epoch boundaries too."""
+    seen = []
+
+    def run(world, steps, state=None):
+        shards = [ElasticShard.from_state(state, rank=r, world=world)
+                  if state is not None else
+                  ElasticShard(N, G, rank=r, world=world, seed=5)
+                  for r in range(world)]
+        for _ in range(steps):
+            batch = []
+            for sh in shards:
+                batch.extend(sh.next_batch())
+            seen.append(batch)
+        return shards[0].state()
+
+    st = run(4, 3)
+    st = run(2, 3, st)          # shrink mid-epoch
+    run(4, 4, st)               # grow back, crossing into epoch 2
+    want = _reference_batches(10)
+    assert len(seen) == 10
+    for s in range(10):
+        # block order IS the world-indexed assignment: rank r owns
+        # [r*G/w, (r+1)*G/w) of the global batch, so the rank-ordered
+        # concatenation equals the fixed-world batch exactly
+        assert seen[s] == want[s], f"step {s + 1} diverged"
+
+
+def test_elastic_shard_epochwise_shuffle_is_a_permutation():
+    sh = ElasticShard(N, G, rank=0, world=1, seed=9)
+    epoch0 = [x for _ in range(N // G) for x in sh.next_batch()]
+    epoch1 = [x for _ in range(N // G) for x in sh.next_batch()]
+    assert sorted(epoch0) == list(range(N))
+    assert sorted(epoch1) == list(range(N))
+    assert epoch0 != epoch1          # reshuffled per epoch
+    assert sh.epoch == 2
+
+
+def test_elastic_shard_rejects_indivisible_world():
+    with pytest.raises(MXNetError, match='not\\s+divisible'):
+        ElasticShard(N, G, rank=0, world=3)
+    sh = ElasticShard(N, G, rank=0, world=2)
+    with pytest.raises(MXNetError, match='not\\s+divisible'):
+        sh.reshard(0, 3)
+    # the failed reshard must not have corrupted the old assignment
+    assert sh.world == 2 and sh.batch_size == G // 2
+
+
+def _tiny(prefix='rs'):
+    net = gluon.nn.Dense(2, in_units=1, prefix=f'{prefix}_')
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_manifest_data_position_round_trip(tmp_path):
+    """The commit manifest carries the data position next to the world
+    metadata; a restore into a DIFFERENT world replays the exact
+    remaining samples (dp=4 -> 2 -> 4)."""
+    net = _tiny()
+    mgr = checkpoint.CheckpointManager(str(tmp_path), params=net,
+                                       async_save=False)
+    shard = ElasticShard(N, G, rank=0, world=4, seed=3)
+    mgr.bind_data_state(lambda: shard.state())
+    for _ in range(3):
+        shard.next_batch()
+    mgr.save(3)
+
+    # restore at world 2: position survives verbatim, block re-splits
+    net2 = _tiny()
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path), params=net2,
+                                        async_save=False)
+    assert mgr2.restore_latest() == 3
+    ds = mgr2.last_restored_metadata['data']
+    assert ds['position'] == 3 * G and ds['world'] == 4
+    assert ds['assignment']['0'] == [0, G // 4]
+    halves = [ElasticShard.from_state(ds, rank=r, world=2)
+              for r in range(2)]
+    want = _reference_batches(8, seed=3)
+    got4 = [x for sh in halves for x in sh.next_batch()]
+    assert got4 == want[3]           # step 4: exact remaining samples
+
+    # grow back to 4 from the SAME manifest state advanced one step
+    st = halves[0].state()
+    quarters = [ElasticShard.from_state(st, rank=r, world=4)
+                for r in range(4)]
+    got5 = [x for sh in quarters for x in sh.next_batch()]
+    assert got5 == want[4]           # step 5: still sample-for-sample
+
+    # the world metadata the manifest already records sits alongside
+    ck = mgr2.restore(3, apply=False)
+    assert 'world' in ck.metadata and 'data' in ck.metadata
+
+
+def test_ndarrayiter_shard_stream(tmp_path):
+    """NDArrayIter with an ElasticShard: per-rank batches follow the
+    shard's world-indexed ids, reset() does NOT rewind the stream, and
+    data_state()/reshard() round-trip the position."""
+    x = onp.arange(N, dtype=onp.float32).reshape(N, 1)
+    it = NDArrayIter(x, shard=ElasticShard(N, G, rank=1, world=2,
+                                           seed=5, shuffle=False))
+    assert it.batch_size == G // 2
+    b1 = it.next()
+    # rank 1 of 2 owns the second half-block of samples [0, G)
+    assert b1.data[0].asnumpy().ravel().tolist() == [4.0, 5.0, 6.0, 7.0]
+    it.reset()
+    b2 = it.next()
+    # a new pass continues the STREAM: position was not rewound
+    assert b2.data[0].asnumpy().ravel().tolist() == [12.0, 13.0, 14.0,
+                                                     15.0]
+    st = it.data_state()
+    assert st['position'] == 2 * G
+    it.reshard(0, 4)
+    assert it.batch_size == G // 4
+    b3 = it.next()
+    assert b3.data[0].asnumpy().ravel().tolist() == [16.0, 17.0]
+
+
+def test_dataloader_elastic_sampler_round_trip():
+    """DataLoader(batch_sampler=ElasticSampler): world-indexed batches,
+    manifest state through data_state(), reshard() re-partitions."""
+    from mxnet_tpu.gluon.data import ArrayDataset
+    x = onp.arange(N, dtype=onp.float32).reshape(N, 1)
+    ds = ArrayDataset(x)
+    smp = ElasticSampler(N, G, rank=0, world=2, seed=0, shuffle=False)
+    dl = DataLoader(ds, batch_sampler=smp)
+    batches = [b.asnumpy().ravel().tolist() for b in dl]
+    assert batches[0] == [0.0, 1.0, 2.0, 3.0]        # first half-block
+    st = dl.data_state()
+    assert st['position'] == N                       # one epoch drawn
+    dl.reshard(1, 2)
+    nxt = next(iter(dl)).asnumpy().ravel().tolist()
+    assert nxt == [4.0, 5.0, 6.0, 7.0]               # other half now
+    with pytest.raises(MXNetError, match='not elastic'):
+        DataLoader(ds, batch_size=4).reshard(0, 1)
+
+
+def test_churn_kill_schedule_deterministic():
+    """The churn drill's randomized kill steps come from the fault
+    registry's hash stream: same seed -> same storm, any process."""
+    from mxnet_tpu.resilience.faults import _unit
+    a = [_unit(23, c) for c in range(6)]
+    assert a == [_unit(23, c) for c in range(6)]     # deterministic
+    assert all(0.0 <= u < 1.0 for u in a)
+    assert len(set(a)) == 6                          # and spread out
+    assert a != [_unit(24, c) for c in range(6)]     # seed-sensitive

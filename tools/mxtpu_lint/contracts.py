@@ -115,6 +115,13 @@ SUBSYSTEM_METRICS = {
         'mxnet_tpu_elastic_reforms_total': 'counter',
         'mxnet_tpu_elastic_last_world_size': 'gauge',
         'mxnet_tpu_elastic_reform_seconds': 'histogram',
+        # elastic scale-UP (ISSUE 20): JOIN announcements received,
+        # the quiesce->rendezvous->restore wall time of each admission
+        # re-form, and autoscaler decisions by kind
+        # (evict / request_capacity / admit)
+        'mxnet_tpu_elastic_joins_total': 'counter',
+        'mxnet_tpu_elastic_admission_seconds': 'histogram',
+        'mxnet_tpu_elastic_autoscaler_decisions_total': 'counter',
     },
     'mxnet_tpu_trace_': {
         # step-span tracer (MXTPU_TRACE): spans recorded, whole spans
@@ -312,8 +319,9 @@ SPAN_NAMES = frozenset({
     'checkpoint.snapshot', 'checkpoint.write', 'checkpoint.restore',
     # host syncs made visible
     'sync.lease_drain',
-    # resilience
-    'guard.rollback', 'elastic.reform',
+    # resilience (elastic.admit: the scale-up admission re-form window,
+    # survivors and joiner alike — ISSUE 20)
+    'guard.rollback', 'elastic.reform', 'elastic.admit',
     # compilation observability (ISSUE 16): the build-site window span
     # plus the jax.monitoring-attributed phase spans (emitted
     # interpolated as f'compile.{phase}' — the static rule checks
@@ -347,9 +355,12 @@ FLIGHT_NOTE_NAMES = frozenset({
     'fault', 'guard.bad_step', 'guard.rollback',
     # watchdog
     'watchdog.stall',
-    # elastic membership / re-form controller
+    # elastic membership / re-form controller (+ the ISSUE 20 scale-up
+    # path: JOIN announcements, admission re-forms, and the
+    # autoscaler's decision ledger)
     'elastic.peer_loss', 'elastic.peer_loss_suspected',
     'elastic.preempt_exit', 'elastic.reform',
+    'elastic.join', 'elastic.admit', 'autoscaler.decision',
     # checkpoint replication + scrubbing
     'checkpoint.replicated', 'checkpoint.replica_failed',
     'checkpoint.replica_dropped', 'checkpoint.replica_restore',
